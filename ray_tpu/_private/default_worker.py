@@ -1,0 +1,32 @@
+"""Worker process entrypoint (reference:
+python/ray/_private/workers/default_worker.py → RunTaskExecutionLoop)."""
+
+import logging
+import os
+
+
+def main():
+    logging.basicConfig(level=os.environ.get("RTPU_LOG_LEVEL", "WARNING"))
+    from ray_tpu._private.worker import Worker, MODE_WORKER
+
+    w = Worker()
+    w.connect(
+        mode=MODE_WORKER,
+        gcs_address=os.environ["RTPU_GCS_ADDRESS"],
+        raylet_address=os.environ["RTPU_RAYLET_ADDRESS"],
+        store_path=os.environ["RTPU_STORE_PATH"],
+        node_id=os.environ["RTPU_NODE_ID"],
+        session_dir=os.environ["RTPU_SESSION_DIR"],
+    )
+    reply = w.call_sync(w.raylet, "worker_register", {
+        "worker_id": os.environ["RTPU_WORKER_ID"],
+        "address": w.address,
+    })
+    from ray_tpu.common.config import SystemConfig, set_global_config
+    w.config = SystemConfig.from_json(reply["config"])
+    set_global_config(w.config)
+    w.task_execution_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Function/actor-class distribution via GCS KV.
+
+Reference semantics: python/ray/_private/function_manager.py +
+_private/import_thread.py — functions are cloudpickled once, exported to the
+GCS KV keyed by hash, and lazily imported (and cached) in workers. Here the
+fetch is pull-based at first use instead of an import thread; the cache is
+per-process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict
+
+import cloudpickle
+
+
+class FunctionManager:
+    def __init__(self, kv_call):
+        """kv_call(method, payload) -> reply; bound to the process's GCS conn."""
+        self._kv_call = kv_call
+        self._cache: Dict[str, Any] = {}
+        self._exported: set[str] = set()
+        self._lock = threading.Lock()
+
+    def export(self, obj: Any, kind: str = "fn") -> str:
+        blob = cloudpickle.dumps(obj, protocol=5)
+        key = f"{kind}:{hashlib.sha1(blob).hexdigest()}"
+        with self._lock:
+            if key in self._exported:
+                return key
+        self._kv_call("kv_put", {"key": "@fn/" + key, "value": blob,
+                                 "overwrite": False})
+        with self._lock:
+            self._exported.add(key)
+            self._cache[key] = obj
+        return key
+
+    def fetch(self, key: str) -> Any:
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        reply = self._kv_call("kv_get", {"key": "@fn/" + key})
+        blob = reply.get("value")
+        if blob is None:
+            raise KeyError(f"function {key} not found in GCS")
+        obj = cloudpickle.loads(blob)
+        with self._lock:
+            self._cache[key] = obj
+        return obj

@@ -1,0 +1,776 @@
+"""GCS — the head-node control plane.
+
+Role-equivalent to the reference's gcs_server (reference:
+src/ray/gcs/gcs_server/gcs_server.cc wiring gcs_node_manager,
+gcs_actor_manager + gcs_actor_scheduler, gcs_job_manager,
+gcs_placement_group_manager/_scheduler, gcs_kv_manager, pubsub_handler,
+gcs_health_check_manager). One asyncio process holds:
+
+  - node table + resource view (raylets report periodically — the analogue of
+    the ray_syncer resource gossip, common/ray_syncer/ray_syncer.h)
+  - cluster scheduler: hybrid pack/spread node selection for spillback and
+    actor placement, TPU-slice aware
+  - actor directory + state machine (DEPENDENCIES_UNREADY → PENDING_CREATION →
+    ALIVE ⇄ RESTARTING → DEAD, reference: src/ray/design_docs/actor_states.rst)
+  - placement groups with 2-phase commit against raylets (reference:
+    gcs_placement_group_scheduler.cc Prepare/Commit/CancelResourceReserve)
+  - KV store (function blobs, runtime-env URIs, cluster metadata, rendezvous)
+  - pubsub channels (actor state, node events, logs, errors)
+  - object directory (object -> node locations, for inter-node transfer)
+  - job table
+  - health: an active disconnect/heartbeat monitor; node death is broadcast
+
+State is held in plain dicts; a `StoreClient` abstraction (in-memory default,
+file-backed snapshot optional) mirrors the reference's pluggable gcs storage
+(gcs/store_client/) so GCS fault tolerance can be added without changing
+managers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu.common.config import SystemConfig
+
+logger = logging.getLogger(__name__)
+
+# Actor states (reference: design_docs/actor_states.rst)
+DEPS_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class InMemoryStore:
+    """Pluggable persistence seam (reference: gcs/store_client/)."""
+
+    def __init__(self):
+        self.tables: Dict[str, Dict[bytes, Any]] = {}
+
+    def table(self, name: str) -> Dict[bytes, Any]:
+        return self.tables.setdefault(name, {})
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, payload: Dict[str, Any],
+                 conn: protocol.Connection):
+        self.node_id = node_id
+        self.raylet_address: str = payload["raylet_address"]
+        self.object_store_path: str = payload["object_store_path"]
+        self.hostname: str = payload.get("hostname", "")
+        self.total_resources: Dict[str, float] = dict(payload["resources"])
+        self.available_resources: Dict[str, float] = dict(payload["resources"])
+        self.labels: Dict[str, str] = dict(payload.get("labels", {}))
+        # TPU topology: e.g. {"slice": "v5e-8-abc", "topology": "v5e-8",
+        # "worker_index": 0, "num_slice_hosts": 2}
+        self.tpu: Dict[str, Any] = dict(payload.get("tpu", {}))
+        self.conn = conn
+        self.alive = True
+        self.last_seen = time.monotonic()
+        self.is_head = bool(payload.get("is_head"))
+
+
+class GcsServer:
+    def __init__(self, config: SystemConfig):
+        self.config = config
+        self.store = InMemoryStore()
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.actors: Dict[str, Dict[str, Any]] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> id
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.placement_groups: Dict[str, Dict[str, Any]] = {}
+        self.object_locations: Dict[bytes, Set[str]] = {}
+        self.object_owners: Dict[bytes, str] = {}  # object hex -> worker addr
+        self.subscribers: Dict[str, Set[protocol.Connection]] = {}
+        self.next_job_index = 1
+        self._server = protocol.Server(self._handlers())
+        self._actor_creation_waiters: Dict[str, List[asyncio.Future]] = {}
+        self._shutdown = asyncio.Event()
+
+    # ------------------------------------------------------------------ wiring
+
+    def _handlers(self):
+        h = {
+            "_on_connect": self._on_connect,
+            "_on_disconnect": self._on_disconnect,
+            "register_node": self.register_node,
+            "resource_report": self.resource_report,
+            "get_nodes": self.get_nodes,
+            "drain_node": self.drain_node,
+            "kv_put": self.kv_put,
+            "kv_get": self.kv_get,
+            "kv_del": self.kv_del,
+            "kv_keys": self.kv_keys,
+            "kv_exists": self.kv_exists,
+            "next_job_id": self.next_job_id,
+            "add_job": self.add_job,
+            "get_jobs": self.get_jobs,
+            "register_actor": self.register_actor,
+            "create_actor": self.create_actor,
+            "get_actor": self.get_actor,
+            "get_named_actor": self.get_named_actor,
+            "list_named_actors": self.list_named_actors,
+            "actor_state_update": self.actor_state_update,
+            "kill_actor": self.kill_actor,
+            "wait_actor_alive": self.wait_actor_alive,
+            "list_actors": self.list_actors,
+            "schedule": self.schedule,
+            "create_placement_group": self.create_placement_group,
+            "remove_placement_group": self.remove_placement_group,
+            "get_placement_group": self.get_placement_group,
+            "list_placement_groups": self.list_placement_groups,
+            "subscribe": self.subscribe,
+            "publish": self.publish,
+            "add_object_location": self.add_object_location,
+            "remove_object_location": self.remove_object_location,
+            "get_object_locations": self.get_object_locations,
+            "cluster_resources": self.cluster_resources,
+            "available_resources": self.available_resources,
+            "ping": self.ping,
+        }
+        return h
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.port = await self._server.start_tcp(host, port)
+        asyncio.get_running_loop().create_task(self._health_loop())
+        logger.info("GCS listening on %s:%s", host, self.port)
+        return self.port
+
+    async def _on_connect(self, conn):
+        pass
+
+    async def _on_disconnect(self, conn):
+        # raylet connection drop == node death (active health check analogue,
+        # reference: gcs_health_check_manager.cc)
+        node_id = conn.meta.get("node_id")
+        if node_id and node_id in self.nodes and self.nodes[node_id].alive:
+            await self._mark_node_dead(node_id, "raylet disconnected")
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+
+    async def _health_loop(self):
+        period = self.config.health_check_period_s
+        while not self._shutdown.is_set():
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_seen > \
+                        self.config.health_check_timeout_s:
+                    await self._mark_node_dead(node.node_id, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.alive = False
+        logger.warning("node %s dead: %s", node_id[:8], reason)
+        await self._publish("node_events",
+                            {"event": "dead", "node_id": node_id,
+                             "reason": reason})
+        # fail actors on that node; restart where policy allows
+        for aid, info in list(self.actors.items()):
+            if info.get("node_id") == node_id and info["state"] in (
+                    ALIVE, PENDING_CREATION, RESTARTING):
+                await self._handle_actor_failure(
+                    aid, f"node {node_id[:8]} died: {reason}")
+        # drop object locations
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(node_id)
+
+    # ------------------------------------------------------------------- nodes
+
+    async def register_node(self, payload, conn):
+        node_id = payload["node_id"]
+        info = NodeInfo(node_id, payload, conn)
+        self.nodes[node_id] = info
+        conn.meta["node_id"] = node_id
+        await self._publish("node_events", {"event": "alive",
+                                            "node_id": node_id,
+                                            "resources": info.total_resources})
+        return {"config": self.config.to_json()}
+
+    async def resource_report(self, payload, conn):
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {}
+        node.available_resources = payload["available"]
+        node.total_resources = payload.get("total", node.total_resources)
+        node.last_seen = time.monotonic()
+        return {}
+
+    async def get_nodes(self, payload, conn):
+        return [{
+            "node_id": n.node_id,
+            "alive": n.alive,
+            "raylet_address": n.raylet_address,
+            "object_store_path": n.object_store_path,
+            "resources": n.total_resources,
+            "available": n.available_resources,
+            "labels": n.labels,
+            "tpu": n.tpu,
+            "is_head": n.is_head,
+        } for n in self.nodes.values()]
+
+    async def drain_node(self, payload, conn):
+        await self._mark_node_dead(payload["node_id"], "drained")
+        return {}
+
+    async def cluster_resources(self, payload, conn):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total_resources.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    async def available_resources(self, payload, conn):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.available_resources.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    # --------------------------------------------------------------------- kv
+
+    async def kv_put(self, payload, conn):
+        key = payload["key"]
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and key in self.kv:
+            return {"added": False}
+        self.kv[key] = payload["value"]
+        return {"added": True}
+
+    async def kv_get(self, payload, conn):
+        return {"value": self.kv.get(payload["key"])}
+
+    async def kv_del(self, payload, conn):
+        prefix = payload.get("prefix", False)
+        key = payload["key"]
+        if prefix:
+            n = 0
+            for k in [k for k in self.kv if k.startswith(key)]:
+                del self.kv[k]
+                n += 1
+            return {"deleted": n}
+        return {"deleted": int(self.kv.pop(key, None) is not None)}
+
+    async def kv_keys(self, payload, conn):
+        prefix = payload.get("prefix", "")
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    async def kv_exists(self, payload, conn):
+        return {"exists": payload["key"] in self.kv}
+
+    # -------------------------------------------------------------------- jobs
+
+    async def next_job_id(self, payload, conn):
+        idx = self.next_job_index
+        self.next_job_index += 1
+        return {"job_index": idx}
+
+    async def add_job(self, payload, conn):
+        self.jobs[payload["job_id"]] = {
+            "job_id": payload["job_id"],
+            "driver_pid": payload.get("driver_pid"),
+            "start_time": time.time(),
+            "namespace": payload.get("namespace", ""),
+            "metadata": payload.get("metadata", {}),
+            "status": "RUNNING",
+        }
+        return {}
+
+    async def get_jobs(self, payload, conn):
+        return list(self.jobs.values())
+
+    # ----------------------------------------------------------------- pubsub
+
+    async def subscribe(self, payload, conn):
+        for channel in payload["channels"]:
+            self.subscribers.setdefault(channel, set()).add(conn)
+        return {}
+
+    async def publish(self, payload, conn):
+        await self._publish(payload["channel"], payload["message"])
+        return {}
+
+    async def _publish(self, channel: str, message):
+        dead = []
+        for sub in self.subscribers.get(channel, ()):  # push-based pubsub
+            try:
+                await sub.notify("pubsub", {"channel": channel,
+                                            "message": message})
+            except Exception:
+                dead.append(sub)
+        for d in dead:
+            self.subscribers.get(channel, set()).discard(d)
+
+    # ---------------------------------------------------------------- actors
+
+    async def register_actor(self, payload, conn):
+        """Persist registration before scheduling (reference semantics:
+        RegisterActor persists before dependency resolution so the actor
+        survives owner-failure windows; actor_states.rst)."""
+        aid = payload["actor_id"]
+        name = payload.get("name")
+        ns = payload.get("namespace", "")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors:
+                existing = self.named_actors[key]
+                if self.actors.get(existing, {}).get("state") != DEAD:
+                    if payload.get("get_if_exists"):
+                        return {"actor_id": existing, "existing": True}
+                    return {"error": f"actor name {name!r} taken in "
+                                     f"namespace {ns!r}"}
+            self.named_actors[key] = aid
+        self.actors[aid] = {
+            "actor_id": aid,
+            "name": name,
+            "namespace": ns,
+            "state": DEPS_UNREADY,
+            "class_name": payload.get("class_name", ""),
+            "owner_address": payload.get("owner_address"),
+            "detached": payload.get("detached", False),
+            "resources": payload.get("resources", {}),
+            "max_restarts": payload.get("max_restarts", 0),
+            "num_restarts": 0,
+            "node_id": None,
+            "worker_address": None,
+            "create_spec": payload.get("create_spec"),
+            "scheduling": payload.get("scheduling", {}),
+            "death_cause": None,
+        }
+        return {"actor_id": aid, "existing": False}
+
+    async def create_actor(self, payload, conn):
+        """Dependency-resolved: schedule and start the actor process-side.
+
+        Reference: GcsActorScheduler::Schedule (gcs_actor_scheduler.cc:49) —
+        GCS picks a node, leases a worker from that raylet, pushes creation.
+        """
+        aid = payload["actor_id"]
+        info = self.actors.get(aid)
+        if info is None:
+            return {"error": "unknown actor"}
+        info["create_spec"] = payload.get("create_spec", info.get("create_spec"))
+        asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+        return {}
+
+    async def _schedule_actor(self, aid: str):
+        info = self.actors.get(aid)
+        if info is None or info["state"] == DEAD:
+            return
+        info["state"] = PENDING_CREATION
+        demand = info.get("resources", {})
+        sched = info.get("scheduling", {})
+        deadline = time.monotonic() + self.config.worker_lease_timeout_s * 10
+        while time.monotonic() < deadline:
+            node_id = self._pick_node(demand, sched)
+            if node_id is None:
+                await asyncio.sleep(0.2)  # wait for resources/nodes
+                continue
+            node = self.nodes[node_id]
+            try:
+                reply = await node.conn.call("create_actor_worker", {
+                    "actor_id": aid,
+                    "create_spec": info["create_spec"],
+                    "resources": demand,
+                }, timeout=self.config.worker_start_timeout_s)
+            except Exception as e:
+                logger.warning("actor %s creation on %s failed: %s",
+                               aid[:8], node_id[:8], e)
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("error"):
+                err = reply["error"]
+                if reply.get("retryable", True):
+                    await asyncio.sleep(0.2)
+                    continue
+                await self._mark_actor_dead(aid, err)
+                return
+            info["node_id"] = node_id
+            info["worker_address"] = reply["worker_address"]
+            info["state"] = ALIVE
+            await self._publish("actor_events",
+                                {"actor_id": aid, "state": ALIVE,
+                                 "worker_address": reply["worker_address"]})
+            for fut in self._actor_creation_waiters.pop(aid, []):
+                if not fut.done():
+                    fut.set_result(info)
+            return
+        await self._mark_actor_dead(aid, "actor creation timed out (resources "
+                                         "never became available)")
+
+    async def _handle_actor_failure(self, aid: str, reason: str):
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        max_restarts = info.get("max_restarts", 0)
+        if max_restarts == -1 or info["num_restarts"] < max_restarts:
+            info["num_restarts"] += 1
+            info["state"] = RESTARTING
+            await self._publish("actor_events",
+                                {"actor_id": aid, "state": RESTARTING})
+            asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+        else:
+            await self._mark_actor_dead(aid, reason)
+
+    async def _mark_actor_dead(self, aid: str, reason: str):
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        info["state"] = DEAD
+        info["death_cause"] = reason
+        await self._publish("actor_events",
+                            {"actor_id": aid, "state": DEAD, "reason": reason})
+        for fut in self._actor_creation_waiters.pop(aid, []):
+            if not fut.done():
+                fut.set_result(info)
+
+    async def actor_state_update(self, payload, conn):
+        aid = payload["actor_id"]
+        state = payload["state"]
+        if state == DEAD:
+            info = self.actors.get(aid)
+            if info is None:
+                return {}
+            if payload.get("restart", False) and not payload.get("intended"):
+                await self._handle_actor_failure(aid, payload.get("reason", ""))
+            else:
+                await self._mark_actor_dead(aid, payload.get("reason", ""))
+        return {}
+
+    async def kill_actor(self, payload, conn):
+        aid = payload["actor_id"]
+        info = self.actors.get(aid)
+        if info is None or info["state"] == DEAD:
+            return {}
+        node = self.nodes.get(info.get("node_id") or "")
+        info["max_restarts"] = 0 if payload.get("no_restart", True) else \
+            info["max_restarts"]
+        if node is not None and info.get("worker_address"):
+            try:
+                await node.conn.call("kill_actor_worker", {
+                    "actor_id": aid,
+                    "worker_address": info["worker_address"],
+                })
+            except Exception:
+                pass
+        await self._mark_actor_dead(aid, "ray_tpu.kill() called")
+        return {}
+
+    async def get_actor(self, payload, conn):
+        info = self.actors.get(payload["actor_id"])
+        if info is None:
+            return {"error": "unknown actor"}
+        return {k: v for k, v in info.items() if k != "create_spec"}
+
+    async def get_named_actor(self, payload, conn):
+        key = (payload.get("namespace", ""), payload["name"])
+        aid = self.named_actors.get(key)
+        if aid is None:
+            return {"error": f"no actor named {payload['name']!r}"}
+        return await self.get_actor({"actor_id": aid}, conn)
+
+    async def list_named_actors(self, payload, conn):
+        ns = payload.get("namespace")
+        out = []
+        for (actor_ns, name), aid in self.named_actors.items():
+            if ns is not None and actor_ns != ns:
+                continue
+            if self.actors.get(aid, {}).get("state") != DEAD:
+                out.append({"name": name, "namespace": actor_ns})
+        return out
+
+    async def list_actors(self, payload, conn):
+        return [{k: v for k, v in info.items() if k != "create_spec"}
+                for info in self.actors.values()]
+
+    async def wait_actor_alive(self, payload, conn):
+        aid = payload["actor_id"]
+        info = self.actors.get(aid)
+        if info is None:
+            return {"error": "unknown actor"}
+        if info["state"] == ALIVE or info["state"] == DEAD:
+            return {k: v for k, v in info.items() if k != "create_spec"}
+        fut = asyncio.get_running_loop().create_future()
+        self._actor_creation_waiters.setdefault(aid, []).append(fut)
+        timeout = payload.get("timeout", 120.0)
+        try:
+            info = await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return {"error": "timeout waiting for actor to start"}
+        return {k: v for k, v in info.items() if k != "create_spec"}
+
+    # ------------------------------------------------------------- scheduling
+
+    def _feasible(self, node: NodeInfo, demand: Dict[str, float],
+                  strict_labels: Dict[str, str] | None = None) -> bool:
+        if not node.alive:
+            return False
+        for k, v in (strict_labels or {}).items():
+            if node.labels.get(k) != v and str(node.tpu.get(k)) != str(v):
+                return False
+        for k, v in demand.items():
+            if node.available_resources.get(k, 0.0) + 1e-9 < v:
+                return False
+        return True
+
+    def _pick_node(self, demand: Dict[str, float],
+                   sched: Dict[str, Any] | None = None) -> Optional[str]:
+        """Hybrid policy (reference: hybrid_scheduling_policy.cc): prefer the
+        preferred/local node until its utilization crosses
+        scheduler_spread_threshold, then spread to the least-utilized feasible
+        node. NodeAffinity and TPU-slice constraints are strict filters."""
+        sched = sched or {}
+        if sched.get("node_id"):
+            node = self.nodes.get(sched["node_id"])
+            if node is not None and self._feasible(node, demand):
+                return node.node_id
+            if not sched.get("soft", False):
+                return None
+        labels = {}
+        if sched.get("tpu_topology"):
+            labels["topology"] = sched["tpu_topology"]
+        candidates = [n for n in self.nodes.values()
+                      if self._feasible(n, demand, labels)]
+        if not candidates:
+            return None
+        if sched.get("spread"):
+            return min(candidates, key=self._utilization).node_id
+        preferred = sched.get("preferred_node")
+        if preferred:
+            node = self.nodes.get(preferred)
+            if node is not None and node in candidates and \
+                    self._utilization(node) < self.config.scheduler_spread_threshold:
+                return preferred
+        return min(candidates, key=self._utilization).node_id
+
+    @staticmethod
+    def _utilization(node: NodeInfo) -> float:
+        worst = 0.0
+        for k, total in node.total_resources.items():
+            if total <= 0:
+                continue
+            avail = node.available_resources.get(k, 0.0)
+            worst = max(worst, 1.0 - avail / total)
+        return worst
+
+    async def schedule(self, payload, conn):
+        """Spillback scheduling for tasks a raylet can't place locally."""
+        node_id = self._pick_node(payload.get("demand", {}),
+                                  payload.get("scheduling"))
+        if node_id is None:
+            return {"node_id": None}
+        return {"node_id": node_id,
+                "raylet_address": self.nodes[node_id].raylet_address}
+
+    # ------------------------------------------------------ placement groups
+
+    async def create_placement_group(self, payload, conn):
+        """2-phase commit of bundles (reference:
+        gcs_placement_group_scheduler.cc Prepare/Commit/CancelResourceReserve
+        over node_manager.proto:377-384). STRICT_PACK over a TPU slice
+        co-schedules all hosts of that slice — the ICI domain is the locality
+        unit (SURVEY.md §7 phase 1)."""
+        pg_id = payload["pg_id"]
+        bundles: List[Dict[str, float]] = payload["bundles"]
+        strategy = payload.get("strategy", "PACK")
+        assignment = self._place_bundles(bundles, strategy)
+        if assignment is None:
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "state": "PENDING", "bundles": bundles,
+                "strategy": strategy, "assignment": None,
+                "name": payload.get("name"),
+            }
+            # retry in background as resources free up
+            asyncio.get_running_loop().create_task(
+                self._retry_pg(pg_id))
+            return {"state": "PENDING"}
+        ok = await self._commit_bundles(pg_id, bundles, assignment)
+        if not ok:
+            return {"state": "PENDING"}
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
+            "strategy": strategy, "assignment": assignment,
+            "name": payload.get("name"),
+        }
+        return {"state": "CREATED", "assignment": assignment}
+
+    async def _retry_pg(self, pg_id: str):
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            await asyncio.sleep(0.5)
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                return
+            if pg["state"] != "PENDING":
+                return
+            assignment = self._place_bundles(pg["bundles"], pg["strategy"])
+            if assignment is None:
+                continue
+            if await self._commit_bundles(pg_id, pg["bundles"], assignment):
+                pg["state"] = "CREATED"
+                pg["assignment"] = assignment
+                await self._publish("pg_events",
+                                    {"pg_id": pg_id, "state": "CREATED"})
+                return
+
+    def _place_bundles(self, bundles, strategy) -> Optional[List[str]]:
+        avail = {nid: dict(n.available_resources)
+                 for nid, n in self.nodes.items() if n.alive}
+
+        def fits(nid, bundle):
+            return all(avail[nid].get(k, 0) + 1e-9 >= v
+                       for k, v in bundle.items())
+
+        def take(nid, bundle):
+            for k, v in bundle.items():
+                avail[nid][k] = avail[nid].get(k, 0) - v
+
+        assignment: List[str] = []
+        node_ids = list(avail)
+        if strategy in ("PACK", "STRICT_PACK"):
+            # try to fit all on one node first
+            for nid in node_ids:
+                ok = True
+                tmp = dict(avail[nid])
+                for b in bundles:
+                    if all(tmp.get(k, 0) + 1e-9 >= v for k, v in b.items()):
+                        for k, v in b.items():
+                            tmp[k] = tmp.get(k, 0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [nid] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+        used_nodes: Set[str] = set()
+        for b in bundles:
+            placed = None
+            for nid in sorted(node_ids,
+                              key=lambda n: (n in used_nodes)
+                              if strategy in ("SPREAD", "STRICT_SPREAD")
+                              else (n not in used_nodes)):
+                if strategy == "STRICT_SPREAD" and nid in used_nodes:
+                    continue
+                if fits(nid, b):
+                    placed = nid
+                    break
+            if placed is None:
+                return None
+            take(placed, b)
+            used_nodes.add(placed)
+            assignment.append(placed)
+        return assignment
+
+    async def _commit_bundles(self, pg_id, bundles, assignment) -> bool:
+        # phase 1: prepare (reserve) on each raylet
+        prepared: List[Tuple[str, int]] = []
+        ok = True
+        for idx, (bundle, nid) in enumerate(zip(bundles, assignment)):
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                ok = False
+                break
+            try:
+                r = await node.conn.call("prepare_bundle", {
+                    "pg_id": pg_id, "bundle_index": idx, "resources": bundle})
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append((nid, idx))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for nid, idx in prepared:
+                node = self.nodes.get(nid)
+                if node is not None:
+                    try:
+                        await node.conn.call("cancel_bundle",
+                                             {"pg_id": pg_id,
+                                              "bundle_index": idx})
+                    except Exception:
+                        pass
+            return False
+        # phase 2: commit
+        for nid, idx in prepared:
+            try:
+                await self.nodes[nid].conn.call(
+                    "commit_bundle", {"pg_id": pg_id, "bundle_index": idx})
+            except Exception:
+                pass
+        return True
+
+    async def remove_placement_group(self, payload, conn):
+        pg = self.placement_groups.pop(payload["pg_id"], None)
+        if pg is None:
+            return {}
+        if pg.get("assignment"):
+            for idx, nid in enumerate(pg["assignment"]):
+                node = self.nodes.get(nid)
+                if node is not None and node.alive:
+                    try:
+                        await node.conn.call("return_bundle", {
+                            "pg_id": pg["pg_id"], "bundle_index": idx})
+                    except Exception:
+                        pass
+        return {}
+
+    async def get_placement_group(self, payload, conn):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is None:
+            return {"error": "unknown placement group"}
+        return pg
+
+    async def list_placement_groups(self, payload, conn):
+        return list(self.placement_groups.values())
+
+    # -------------------------------------------------------- object registry
+
+    async def add_object_location(self, payload, conn):
+        oid = payload["object_id"]
+        self.object_locations.setdefault(oid, set()).add(payload["node_id"])
+        if payload.get("owner"):
+            self.object_owners[oid] = payload["owner"]
+        return {}
+
+    async def remove_object_location(self, payload, conn):
+        locs = self.object_locations.get(payload["object_id"])
+        if locs:
+            locs.discard(payload["node_id"])
+        return {}
+
+    async def get_object_locations(self, payload, conn):
+        oid = payload["object_id"]
+        locs = self.object_locations.get(oid, set())
+        out = []
+        for nid in locs:
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                out.append({"node_id": nid,
+                            "raylet_address": node.raylet_address})
+        return {"locations": out, "owner": self.object_owners.get(oid)}
+
+    async def ping(self, payload, conn):
+        return {"t": time.time()}
+
+
+async def run_gcs(config: SystemConfig, host: str, port: int,
+                  ready_cb=None) -> GcsServer:
+    gcs = GcsServer(config)
+    actual = await gcs.start(host, port)
+    if ready_cb:
+        ready_cb(actual)
+    return gcs

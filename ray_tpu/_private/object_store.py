@@ -1,0 +1,233 @@
+"""Python client for the plasmax shared-memory object store.
+
+Role-equivalent to the reference's plasma client
+(reference: src/ray/object_manager/plasma/client.cc and
+core_worker/store_provider/plasma_store_provider.cc), plus the in-process
+memory store for small objects
+(reference: core_worker/store_provider/memory_store/memory_store.cc).
+
+The store is a single mmap'd segment in /dev/shm created by the node process;
+every worker attaches by path. Reads are zero-copy: ``get_buffer`` returns a
+memoryview straight into shared memory.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.common.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+_LIB = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        path = os.path.join(os.path.dirname(__file__), "..", "core", "libplasmax.so")
+        path = os.path.abspath(path)
+        if not os.path.exists(path):
+            _build_lib(path)
+        lib = ctypes.CDLL(path)
+        lib.px_segment_size.restype = ctypes.c_uint64
+        lib.px_segment_size.argtypes = [ctypes.c_uint64, ctypes.c_uint32]
+        lib.px_init.restype = ctypes.c_int
+        lib.px_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.px_attach_check.restype = ctypes.c_int
+        lib.px_attach_check.argtypes = [ctypes.c_void_p]
+        for name in ("px_create", "px_get"):
+            getattr(lib, name).restype = ctypes.c_int
+        lib.px_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        lib.px_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.POINTER(ctypes.c_uint64)]
+        for name in ("px_seal", "px_abort", "px_release", "px_delete",
+                     "px_contains", "px_pin"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for name in ("px_used_bytes", "px_capacity", "px_num_objects",
+                     "px_num_evicted"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.px_stats.restype = None
+        lib.px_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        _LIB = lib
+    return _LIB
+
+
+def _build_lib(out_path: str):
+    """Build libplasmax.so from source on first use (source ships in src/)."""
+    import subprocess
+    src = os.path.join(os.path.dirname(out_path), "..", "..", "src", "plasmax",
+                       "store.cc")
+    src = os.path.abspath(src)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    subprocess.check_call(
+        ["g++", "-O2", "-fPIC", "-shared", "-o", out_path, src, "-lpthread"])
+
+
+DEFAULT_NSLOTS = 1 << 16
+
+
+class PlasmaxStore:
+    """Handle to one shared-memory segment (create or attach by path)."""
+
+    def __init__(self, path: str, capacity: int = 0, create: bool = False,
+                 nslots: int = DEFAULT_NSLOTS):
+        self.path = path
+        self._lib = _lib()
+        if create:
+            seg_size = self._lib.px_segment_size(capacity, nslots)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, seg_size)
+                self._mm = mmap.mmap(fd, seg_size)
+            finally:
+                os.close(fd)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+            rc = self._lib.px_init(self._base, seg_size, nslots)
+            if rc != 0:
+                raise RuntimeError(f"px_init failed: {rc}")
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                seg_size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, seg_size)
+            finally:
+                os.close(fd)
+            self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+            if self._lib.px_attach_check(self._base) != 0:
+                raise RuntimeError(f"not a plasmax segment: {path}")
+        self._size = seg_size
+
+    # -- write path --
+
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate and return a writable view; caller must seal()."""
+        off = ctypes.c_uint64()
+        rc = self._lib.px_create(self._base, oid.binary(), size, ctypes.byref(off))
+        if rc == -1:
+            raise ValueError(f"object {oid} already exists")
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"cannot allocate {size} bytes (capacity {self.capacity()}, "
+                f"used {self.used_bytes()})")
+        if rc == -3:
+            raise ObjectStoreFullError("object index full")
+        return memoryview(self._mm)[off.value:off.value + size]
+
+    def seal(self, oid: ObjectID):
+        rc = self._lib.px_seal(self._base, oid.binary())
+        if rc != 0:
+            raise ValueError(f"seal failed for {oid}: {rc}")
+        # creator's implicit ref is dropped; raylet pins primaries separately
+        self._lib.px_release(self._base, oid.binary())
+
+    def put_bytes(self, oid: ObjectID, data) -> None:
+        buf = self.create(oid, len(data))
+        buf[:] = data
+        self.seal(oid)
+
+    def abort(self, oid: ObjectID):
+        self._lib.px_abort(self._base, oid.binary())
+
+    # -- read path --
+
+    def get_buffer(self, oid: ObjectID) -> Optional[memoryview]:
+        """Zero-copy read view, or None if absent. Caller should release()."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.px_get(self._base, oid.binary(), ctypes.byref(off),
+                              ctypes.byref(size))
+        if rc != 0:
+            return None
+        return memoryview(self._mm)[off.value:off.value + size.value]
+
+    def release(self, oid: ObjectID):
+        self._lib.px_release(self._base, oid.binary())
+
+    def delete(self, oid: ObjectID) -> bool:
+        return self._lib.px_delete(self._base, oid.binary()) == 0
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.px_contains(self._base, oid.binary()))
+
+    def pin(self, oid: ObjectID) -> bool:
+        return self._lib.px_pin(self._base, oid.binary()) == 0
+
+    # -- stats --
+
+    def used_bytes(self) -> int:
+        return self._lib.px_used_bytes(self._base)
+
+    def capacity(self) -> int:
+        return self._lib.px_capacity(self._base)
+
+    def num_objects(self) -> int:
+        return self._lib.px_num_objects(self._base)
+
+    def stats(self) -> Dict[str, int]:
+        arr = (ctypes.c_uint64 * 6)()
+        self._lib.px_stats(self._base, arr)
+        keys = ("used_bytes", "capacity", "num_objects", "num_created",
+                "num_evicted", "bytes_evicted")
+        return dict(zip(keys, arr))
+
+    def close(self):
+        # Views into the mmap must be gone before closing; callers own that.
+        self._base = None
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class MemoryStore:
+    """In-process store for small/inlined objects.
+
+    Reference analogue: CoreWorkerMemoryStore
+    (core_worker/store_provider/memory_store/memory_store.cc) — small results
+    skip shared memory and travel inline through the control plane.
+    """
+
+    def __init__(self):
+        self._store: Dict[ObjectID, bytes] = {}
+        self._lock = threading.Lock()
+        self._waiters: Dict[ObjectID, threading.Event] = {}
+
+    def put(self, oid: ObjectID, payload: bytes):
+        with self._lock:
+            self._store[oid] = payload
+            ev = self._waiters.pop(oid, None)
+        if ev is not None:
+            ev.set()
+
+    def get(self, oid: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(oid)
+
+    def wait_for(self, oid: ObjectID, timeout: Optional[float]) -> Optional[bytes]:
+        with self._lock:
+            if oid in self._store:
+                return self._store[oid]
+            ev = self._waiters.setdefault(oid, threading.Event())
+        if not ev.wait(timeout):
+            return None
+        return self.get(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._store
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            self._store.pop(oid, None)

@@ -1,0 +1,232 @@
+"""Control-plane RPC: msgpack-framed messages over unix/TCP sockets.
+
+Role-equivalent to the reference's gRPC plumbing (reference: src/ray/rpc/ —
+client_call.h, grpc_server.cc) redesigned lighter: the control plane here is a
+msgpack-over-socket protocol with request/reply correlation and one-way
+notifications. Bulk data never rides this plane — large payloads go through
+the plasmax shared-memory store (intra-node) or the chunked object-transfer
+path (inter-node), exactly like the reference splits control (gRPC) from data
+(plasma/object_manager).
+
+Frame: [uint32 length][msgpack body]
+Body:  [msg_type, seq, method, payload]
+  msg_type: 0 = request (expects reply), 1 = reply, 2 = error reply,
+            3 = one-way notification
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+REQUEST, REPLY, ERROR, NOTIFY = 0, 1, 2, 3
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+def pack_frame(body) -> bytes:
+    data = msgpack.packb(body, use_bin_type=True)
+    return struct.pack("<I", len(data)) + data
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack("<I", hdr)
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame too large: {n}")
+    data = await reader.readexactly(n)
+    return msgpack.unpackb(data, raw=False)
+
+
+class RpcError(Exception):
+    pass
+
+
+class Connection:
+    """A bidirectional RPC connection. Either side can issue requests."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handler: Optional[Callable[[str, Any, "Connection"],
+                                            Awaitable[Any]]] = None,
+                 on_close: Optional[Callable[["Connection"], None]] = None):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.on_close = on_close
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self._task = asyncio.get_running_loop().create_task(self._read_loop())
+        # opaque per-connection state the server attaches (e.g. worker id)
+        self.meta: Dict[str, Any] = {}
+
+    async def _read_loop(self):
+        try:
+            while True:
+                frame = await read_frame(self.reader)
+                mtype, seq, method, payload = frame
+                if mtype == REQUEST:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(seq, method, payload))
+                elif mtype == NOTIFY:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(None, method, payload))
+                elif mtype in (REPLY, ERROR):
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        if mtype == REPLY:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("connection closed"))
+            self._pending.clear()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            if self.on_close:
+                self.on_close(self)
+
+    async def _dispatch(self, seq, method, payload):
+        try:
+            result = await self.handler(method, payload, self)
+            if seq is not None:
+                await self._send([REPLY, seq, method, result])
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            if seq is not None:
+                try:
+                    await self._send([ERROR, seq, method,
+                                      f"{type(e).__name__}: {e}"])
+                except Exception:
+                    pass
+
+    async def _send(self, body):
+        async with self._send_lock:
+            self.writer.write(pack_frame(body))
+            await self.writer.drain()
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        seq = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        await self._send([REQUEST, seq, method, payload])
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def notify(self, method: str, payload: Any = None):
+        if self._closed:
+            raise ConnectionError("connection closed")
+        await self._send([NOTIFY, None, method, payload])
+
+    def close(self):
+        self._closed = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class Server:
+    """Accepts connections; dispatches to a method-name handler table."""
+
+    def __init__(self, handlers: Dict[str, Callable]):
+        self.handlers = handlers
+        self.connections: set[Connection] = set()
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def _on_connect(self, reader, writer):
+        conn = Connection(reader, writer, handler=self._handle,
+                          on_close=self._on_close)
+        self.connections.add(conn)
+        if "_on_connect" in self.handlers:
+            await self.handlers["_on_connect"](conn)
+
+    def _on_close(self, conn):
+        self.connections.discard(conn)
+        cb = self.handlers.get("_on_disconnect")
+        if cb is not None:
+            asyncio.get_event_loop().create_task(cb(conn))
+
+    async def _handle(self, method, payload, conn):
+        fn = self.handlers.get(method)
+        if fn is None:
+            raise RpcError(f"no such method: {method}")
+        return await fn(payload, conn)
+
+    async def start_unix(self, path: str):
+        self._server = await asyncio.start_unix_server(self._on_connect, path=path)
+
+    async def start_tcp(self, host: str, port: int) -> int:
+        self._server = await asyncio.start_server(self._on_connect, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    def close(self):
+        if self._server is not None:
+            self._server.close()
+        for c in list(self.connections):
+            c.close()
+
+
+async def connect(address: str,
+                  handler: Optional[Callable] = None,
+                  on_close: Optional[Callable] = None) -> Connection:
+    """address: 'unix:/path' or 'host:port'."""
+    if address.startswith("unix:"):
+        reader, writer = await asyncio.open_unix_connection(address[5:])
+    else:
+        host, port = address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+    if handler is None:
+        async def handler(method, payload, conn):  # noqa: ARG001
+            raise RpcError(f"unexpected request {method}")
+    return Connection(reader, writer, handler=handler, on_close=on_close)
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a background thread.
+
+    Every process (driver, worker, raylet, GCS) runs exactly one of these for
+    its control-plane IO — the analogue of the reference's per-process
+    instrumented_io_context (reference: src/ray/common/asio/). Blocking user
+    threads interact via run()/run_async().
+    """
+
+    def __init__(self, name: str = "rtpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run coroutine on the IO loop, block until done, return result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def run_async(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,40 @@
+"""Raylet process entrypoint (reference: src/ray/raylet/main.cc)."""
+
+import asyncio
+import json
+import logging
+import os
+
+from ray_tpu._private.raylet import Raylet
+from ray_tpu.common.config import SystemConfig
+
+
+async def main():
+    logging.basicConfig(level=os.environ.get("RTPU_LOG_LEVEL", "INFO"))
+    session_dir = os.environ["RTPU_SESSION_DIR"]
+    node_id = os.environ["RTPU_NODE_ID"]
+    raylet = Raylet(
+        config=SystemConfig().apply_env_overrides(),
+        node_id=node_id,
+        session_dir=session_dir,
+        gcs_address=os.environ["RTPU_GCS_ADDRESS"],
+        resources=json.loads(os.environ.get("RTPU_RESOURCES", "{}")),
+        labels=json.loads(os.environ.get("RTPU_LABELS", "{}")),
+        is_head=os.environ.get("RTPU_IS_HEAD") == "1",
+        object_store_memory=int(os.environ["RTPU_OBJECT_STORE_BYTES"])
+        if os.environ.get("RTPU_OBJECT_STORE_BYTES") else None,
+    )
+    await raylet.start()
+    info = {"unix_address": raylet.unix_address,
+            "tcp_address": raylet.address,
+            "store_path": raylet.store_path,
+            "node_id": node_id}
+    tmp = os.path.join(session_dir, f".raylet_{node_id[:8]}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, os.path.join(session_dir, f"raylet_{node_id[:8]}.json"))
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

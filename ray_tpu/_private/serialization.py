@@ -1,0 +1,153 @@
+"""Two-layer wire format: msgpack envelope + pickle5 out-of-band buffers.
+
+Reference semantics: python/ray/_private/serialization.py — a msgpack envelope
+for cheap primitives with an embedded cloudpickle payload whose pickle-protocol-5
+out-of-band buffers enable zero-copy reads of numpy (and here, host-staged
+jax.Array) data straight out of shared memory (SURVEY.md §8.4).
+
+Wire layout:
+    [uint32 header_len][msgpack header][buffer 0][buffer 1]...
+header = {
+    "inline": optional msgpack-native value (fast path, no pickle)
+    "pickle": offset/len of the cloudpickle payload within the buffer region
+    "buffers": list of (offset, len) for out-of-band buffers, 64-byte aligned
+    "error": optional — marks the payload as a serialized exception
+    "refs": list of serialized ObjectRefs contained in the value (for the
+            borrower protocol: the deserializing process registers as a
+            borrower with each ref's owner)
+}
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+
+_ALIGN = 64
+
+# msgpack-native types that skip pickle entirely
+_INLINE_TYPES = (type(None), bool, int, float, str, bytes)
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """A serialization result: header bytes + list of payload buffers."""
+
+    __slots__ = ("header", "buffers", "total_size", "contained_refs")
+
+    def __init__(self, header: bytes, buffers: List, total_size: int,
+                 contained_refs: List):
+        self.header = header
+        self.buffers = buffers
+        self.total_size = total_size
+        self.contained_refs = contained_refs
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+    def write_into(self, dest: memoryview):
+        hlen = len(self.header)
+        struct.pack_into("<I", dest, 0, hlen)
+        dest[4:4 + hlen] = self.header
+        off = _aligned(4 + hlen)
+        for buf in self.buffers:
+            b = memoryview(buf)
+            if b.format != "B":
+                b = b.cast("B")
+            n = b.nbytes
+            dest[off:off + n] = b
+            off = _aligned(off + n)
+
+
+def serialize(value: Any, is_error: bool = False) -> SerializedObject:
+    contained_refs: List = []
+    if not is_error and type(value) in _INLINE_TYPES:
+        header = msgpack.packb({"inline": value, "v": 1},
+                               use_bin_type=True)
+        hlen = len(header)
+        return SerializedObject(header, [], _aligned(4 + hlen), [])
+
+    oob: List[pickle.PickleBuffer] = []
+
+    def buffer_cb(pb: pickle.PickleBuffer) -> bool:
+        raw = pb.raw()
+        if raw.nbytes < 1024:
+            return True  # tiny buffers: keep in-band
+        oob.append(pb)
+        return False
+
+    from ray_tpu._private import ref_serialization
+    with ref_serialization.collecting_refs(contained_refs):
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
+
+    # layout: [pickle payload][oob buffers...]; offsets relative to the start
+    # of the buffer region (which begins at aligned(4 + header_len))
+    metas: List[Tuple[int, int]] = []
+    off = _aligned(len(payload))
+    raws = []
+    for pb in oob:
+        raw = pb.raw()
+        if raw.format != "B":
+            raw = raw.cast("B")
+        metas.append((off, raw.nbytes))
+        raws.append(raw)
+        off = _aligned(off + raw.nbytes)
+    header = msgpack.packb({
+        "pickle": len(payload),
+        "buffers": metas,
+        "error": is_error,
+        "refs": [r for r in contained_refs],
+        "v": 1,
+    }, use_bin_type=True)
+    hlen = len(header)
+    total = _aligned(4 + hlen) + off
+    return SerializedObject(header, [payload] + raws, total, contained_refs)
+
+
+def deserialize(data, out_of_band_ok: bool = True) -> Any:
+    """Deserialize from bytes or a (shared-memory) memoryview.
+
+    When ``data`` is a memoryview into the object store and the payload holds
+    aligned numpy buffers, the arrays returned are zero-copy views; callers
+    that outlive the view must copy (worker task args are copied by default
+    only when the object may be evicted mid-task — primaries are pinned for
+    the task's duration by the raylet, so views are safe there).
+    """
+    view = memoryview(data)
+    hlen = struct.unpack_from("<I", view, 0)[0]
+    header = msgpack.unpackb(bytes(view[4:4 + hlen]), raw=False)
+    if "inline" in header:
+        return header["inline"]
+    region = view[_aligned(4 + hlen):]
+    plen = header["pickle"]
+    payload = region[:plen]
+    buffers = [region[off:off + n] for off, n in header["buffers"]]
+    value = pickle.loads(payload, buffers=buffers)
+    if header.get("error"):
+        raise value
+    return value
+
+
+def peek_is_error(data) -> bool:
+    view = memoryview(data)
+    hlen = struct.unpack_from("<I", view, 0)[0]
+    header = msgpack.unpackb(bytes(view[4:4 + hlen]), raw=False)
+    return bool(header.get("error"))
+
+
+def serialize_error(exc: BaseException) -> SerializedObject:
+    try:
+        return serialize(exc, is_error=True)
+    except Exception:
+        from ray_tpu.exceptions import TaskError
+        return serialize(TaskError("<unserializable>", None, repr(exc)),
+                         is_error=True)

@@ -1,0 +1,1047 @@
+"""The per-process core runtime: driver and worker share this.
+
+Role-equivalent to the reference's CoreWorker + the Python worker layer
+(reference: src/ray/core_worker/core_worker.cc SubmitTask:1621 / Get:1143 /
+Put:936 / ExecuteTask:2235; python/ray/_private/worker.py). Every process —
+driver or worker — embeds one ``Worker``:
+
+  - an RPC server on a unix socket (the process's "core worker service";
+    reference: core_worker.proto) handling task pushes, actor calls, result
+    delivery, borrower registration, and object waits
+  - an in-process memory store for small objects + a plasmax client for the
+    node's shared-memory segment (reference: store_provider/)
+  - the owner-side task manager: pending tasks, retries, and lineage for
+    reconstruction (reference: task_manager.cc, max_retries semantics)
+  - owner-side reference counting with a borrower protocol (simplified from
+    reference_count.cc: borrowers register with the owner on deserialize and
+    notify on release; owner frees cluster-wide when counts reach zero)
+  - the task execution loop (workers) and the actor runtime with per-caller
+    ordering and max_concurrency thread pools (reference:
+    actor_scheduling_queue.cc / concurrency_group_manager.cc)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.object_store import MemoryStore, PlasmaxStore
+from ray_tpu.common.config import SystemConfig, global_config, set_global_config
+from ray_tpu.common.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu import exceptions as exc
+
+logger = logging.getLogger(__name__)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+
+# --------------------------------------------------------------------------
+# ObjectRef
+
+
+class ObjectRef:
+    """A future for an object in the cluster.
+
+    Carries the owner's address so any holder can reach the owner for the
+    borrower protocol and result waiting (reference: ObjectRefs carry owner
+    addresses in their custom reducer, SURVEY.md §8.4).
+    """
+
+    def __init__(self, oid: ObjectID, owner_address: str = "",
+                 *, _register: bool = True):
+        self._id = oid
+        self._owner_address = owner_address
+        self._held_buffer = None
+        w = _global_worker
+        self._worker = w if (w is not None and w.connected) else None
+        if self._worker is not None and _register:
+            self._worker.reference_counter.add_local(oid)
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def owner_address(self) -> str:
+        return self._owner_address
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        w = self._worker
+        if w is not None and w.connected:
+            try:
+                w.reference_counter.remove_local(self._id, self._owner_address)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        from ray_tpu._private import ref_serialization
+        ref_serialization.record_ref((self._id.hex(), self._owner_address))
+        return (_deserialize_ref, (self._id.binary(), self._owner_address))
+
+    def future(self):
+        """A concurrent.futures.Future resolved with the object's value."""
+        from concurrent.futures import Future
+        f: Future = Future()
+
+        def _resolve():
+            try:
+                f.set_result(get(self))
+            except BaseException as e:  # noqa: BLE001
+                f.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return f
+
+    def __await__(self):
+        fut = asyncio.wrap_future(self.future())
+        return fut.__await__()
+
+
+def _deserialize_ref(binary: bytes, owner_address: str) -> ObjectRef:
+    oid = ObjectID(binary)
+    ref = ObjectRef(oid, owner_address, _register=False)
+    w = _global_worker
+    if w is not None and w.connected:
+        w.reference_counter.add_borrowed(oid, owner_address)
+    return ref
+
+
+# --------------------------------------------------------------------------
+# Reference counting (owner side + borrower side)
+
+
+class ReferenceCounter:
+    """Simplified distributed refcounting (reference: reference_count.cc).
+
+    Owner tracks local refs, submitted-task refs, and registered borrowers.
+    Borrowers count their local refs and tell the owner when they hit zero.
+    When the owner's total reaches zero the object is freed cluster-wide.
+    """
+
+    def __init__(self, worker: "Worker"):
+        self.worker = worker
+        self.lock = threading.Lock()
+        # oid -> [local, submitted, borrowers:set, owned:bool, spec|None]
+        self.table: Dict[ObjectID, Dict[str, Any]] = {}
+
+    def _entry(self, oid: ObjectID):
+        return self.table.setdefault(oid, {
+            "local": 0, "submitted": 0, "borrowers": set(),
+            "owned": False, "lineage": None, "in_plasma": False,
+        })
+
+    def add_owned(self, oid: ObjectID, in_plasma: bool = False,
+                  lineage=None):
+        with self.lock:
+            e = self._entry(oid)
+            e["owned"] = True
+            e["in_plasma"] = e["in_plasma"] or in_plasma
+            if lineage is not None:
+                e["lineage"] = lineage
+
+    def add_local(self, oid: ObjectID):
+        with self.lock:
+            self._entry(oid)["local"] += 1
+
+    def remove_local(self, oid: ObjectID, owner_address: str):
+        free = False
+        notify_owner = False
+        with self.lock:
+            e = self.table.get(oid)
+            if e is None:
+                return
+            e["local"] -= 1
+            if e["local"] <= 0 and e["submitted"] <= 0:
+                if e["owned"]:
+                    if not e["borrowers"]:
+                        free = True
+                else:
+                    notify_owner = True
+        if free:
+            self._free(oid)
+        elif notify_owner and owner_address and \
+                owner_address != self.worker.address:
+            self.worker.try_notify(owner_address, "borrow_del",
+                                   {"object_id": oid.hex(),
+                                    "borrower": self.worker.address})
+
+    def add_submitted(self, oid: ObjectID):
+        with self.lock:
+            self._entry(oid)["submitted"] += 1
+
+    def remove_submitted(self, oid: ObjectID):
+        free = False
+        with self.lock:
+            e = self.table.get(oid)
+            if e is None:
+                return
+            e["submitted"] -= 1
+            if e["local"] <= 0 and e["submitted"] <= 0 and e["owned"] and \
+                    not e["borrowers"]:
+                free = True
+        if free:
+            self._free(oid)
+
+    def add_borrowed(self, oid: ObjectID, owner_address: str):
+        """Called when a ref deserializes in this process."""
+        with self.lock:
+            e = self._entry(oid)
+            e["local"] += 1
+            registered = e.get("registered_borrow", False)
+            e["registered_borrow"] = True
+        if not registered and owner_address and \
+                owner_address != self.worker.address:
+            self.worker.try_notify(owner_address, "borrow_add",
+                                   {"object_id": oid.hex(),
+                                    "borrower": self.worker.address})
+
+    def on_borrow_add(self, oid_hex: str, borrower: str):
+        with self.lock:
+            self._entry(ObjectID.from_hex(oid_hex))["borrowers"].add(borrower)
+
+    def on_borrow_del(self, oid_hex: str, borrower: str):
+        oid = ObjectID.from_hex(oid_hex)
+        free = False
+        with self.lock:
+            e = self.table.get(oid)
+            if e is None:
+                return
+            e["borrowers"].discard(borrower)
+            if e["local"] <= 0 and e["submitted"] <= 0 and e["owned"] and \
+                    not e["borrowers"]:
+                free = True
+        if free:
+            self._free(oid)
+
+    def set_lineage(self, oid: ObjectID, spec: Dict[str, Any]):
+        with self.lock:
+            self._entry(oid)["lineage"] = spec
+
+    def get_lineage(self, oid: ObjectID):
+        with self.lock:
+            e = self.table.get(oid)
+            return e.get("lineage") if e else None
+
+    def _free(self, oid: ObjectID):
+        with self.lock:
+            e = self.table.pop(oid, None)
+        if e is None:
+            return
+        self.worker.memory_store.delete(oid)
+        if e.get("in_plasma"):
+            self.worker.free_plasma([oid])
+
+
+# --------------------------------------------------------------------------
+# Worker
+
+
+_global_worker: Optional["Worker"] = None
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None or not _global_worker.connected:
+        raise RuntimeError(
+            "ray_tpu.init() must be called before using the API")
+    return _global_worker
+
+
+class PendingTaskState:
+    __slots__ = ("spec", "retries_left", "return_ids", "done",
+                 "result_event", "worker_address")
+
+    def __init__(self, spec, retries_left, return_ids):
+        self.spec = spec
+        self.retries_left = retries_left
+        self.return_ids = return_ids
+        self.done = False
+        self.result_event = threading.Event()
+        self.worker_address = None
+
+
+class Worker:
+    def __init__(self):
+        self.mode = MODE_DRIVER
+        self.connected = False
+        self.io: Optional[protocol.EventLoopThread] = None
+        self.raylet: Optional[protocol.Connection] = None
+        self.gcs: Optional[protocol.Connection] = None
+        self.memory_store = MemoryStore()
+        self.plasma: Optional[PlasmaxStore] = None
+        self.node_id: str = ""
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.nil()
+        self.address = ""  # this process's core-worker RPC address
+        self.config: SystemConfig = global_config()
+        self.function_manager: Optional[FunctionManager] = None
+        self.reference_counter = ReferenceCounter(self)
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self.task_context = threading.local()
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self.pending_tasks: Dict[str, PendingTaskState] = {}
+        self._peer_conns: Dict[str, protocol.Connection] = {}
+        self._peer_lock = threading.Lock()
+        self.session_dir = ""
+        self.namespace = ""
+        self.runtime_context: Dict[str, Any] = {}
+        # worker-mode execution state
+        self._task_queue: "queue.Queue" = queue.Queue()
+        self._actor_instance = None
+        self._actor_threads: Optional[ThreadPoolExecutor] = None
+        self._actor_lock = threading.Lock()
+        self._actor_async_loop = None
+        self._cancelled_tasks: set = set()
+        self.tpu_chips: List[int] = []
+        self._server: Optional[protocol.Server] = None
+        self._actor_seq: Dict[Tuple[str, str], int] = {}
+        self._actor_waiting: Dict[Tuple[str, str], Dict[int, Any]] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def connect(self, mode: str, gcs_address: str, raylet_address: str,
+                store_path: str, node_id: str, session_dir: str,
+                namespace: str = "", job_id: Optional[JobID] = None):
+        global _global_worker
+        self.mode = mode
+        self.session_dir = session_dir
+        self.namespace = namespace
+        self.node_id = node_id
+        self.io = protocol.EventLoopThread()
+        sock = os.path.join(session_dir,
+                            f"cw_{self.worker_id.hex()[:12]}.sock")
+        self._server = protocol.Server(self._handlers())
+        self.io.run(self._server.start_unix(sock))
+        self.address = f"unix:{sock}"
+        self.gcs = self.io.run(protocol.connect(
+            gcs_address, handler=self._handle_request))
+        self.plasma = PlasmaxStore(store_path)
+        self.function_manager = FunctionManager(
+            lambda m, p: self.io.run(self.gcs.call(m, p)))
+        if raylet_address:
+            self.raylet = self.io.run(protocol.connect(
+                raylet_address, handler=self._handle_request))
+        if mode == MODE_DRIVER:
+            r = self.io.run(self.gcs.call("next_job_id", {}))
+            self.job_id = JobID.from_int(r["job_index"])
+            self.io.run(self.gcs.call("add_job", {
+                "job_id": self.job_id.hex(), "driver_pid": os.getpid(),
+                "namespace": namespace}))
+            self.current_task_id = TaskID.for_driver(self.job_id)
+        elif job_id is not None:
+            self.job_id = job_id
+        self.connected = True
+        _global_worker = self
+
+    def disconnect(self):
+        self.connected = False
+        if self._server is not None:
+            self._server.close()
+        if self.io is not None:
+            self.io.stop()
+
+    # -------------------------------------------------------------- plumbing
+
+    def _handlers(self):
+        return {
+            "task_result": self._h_task_result,
+            "push_task": self._h_push_task,
+            "become_actor": self._h_become_actor,
+            "actor_call": self._h_actor_call,
+            "cancel_task": self._h_cancel_task,
+            "wait_object": self._h_wait_object,
+            "borrow_add": self._h_borrow_add,
+            "borrow_del": self._h_borrow_del,
+            "exit_worker": self._h_exit_worker,
+            "ping": self._h_ping,
+        }
+
+    async def _handle_request(self, method, payload, conn):
+        fn = self._handlers().get(method)
+        if fn is None:
+            raise protocol.RpcError(f"core worker: no method {method}")
+        return await fn(payload, conn)
+
+    async def _peer(self, address: str) -> protocol.Connection:
+        with self._peer_lock:
+            conn = self._peer_conns.get(address)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = await protocol.connect(address, handler=self._handle_request)
+        with self._peer_lock:
+            self._peer_conns[address] = conn
+        return conn
+
+    def try_notify(self, address: str, method: str, payload):
+        """Fire-and-forget from any thread."""
+        if self.io is None:
+            return
+
+        async def _go():
+            try:
+                conn = await self._peer(address)
+                await conn.notify(method, payload)
+            except Exception:
+                pass
+        try:
+            self.io.run_async(_go())
+        except Exception:
+            pass
+
+    def call_sync(self, conn: protocol.Connection, method: str, payload,
+                  timeout=None):
+        return self.io.run(conn.call(method, payload, timeout=timeout))
+
+    # ------------------------------------------------------------------- put
+
+    def next_put_id(self) -> ObjectID:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        task_id = self.current_task_id or TaskID.for_driver(self.job_id)
+        return ObjectID.for_put(task_id, idx)
+
+    def put_object(self, value: Any, owner_ref: Optional[ObjectRef] = None
+                   ) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        oid = self.next_put_id()
+        ser = serialization.serialize(value)
+        self._store_serialized(oid, ser)
+        self.reference_counter.add_owned(
+            oid, in_plasma=ser.total_size > self.config.max_inline_object_size)
+        return ObjectRef(oid, self.address)
+
+    def _store_serialized(self, oid: ObjectID, ser) -> Dict[str, Any]:
+        """Store a SerializedObject; returns a result descriptor."""
+        if ser.total_size <= self.config.max_inline_object_size:
+            payload = ser.to_bytes()
+            self.memory_store.put(oid, payload)
+            return {"object_id": oid.hex(), "inline": payload,
+                    "owner": self.address}
+        buf = self.plasma.create(oid, ser.total_size)
+        ser.write_into(buf)
+        buf.release()
+        self.plasma.seal(oid)
+        # pin the primary copy at this node's raylet + publish location
+        if self.raylet is not None:
+            try:
+                self.call_sync(self.raylet, "pin_object",
+                               {"object_id": oid.hex(), "owner": self.address})
+            except Exception:
+                pass
+        return {"object_id": oid.hex(), "plasma": True, "node_id": self.node_id,
+                "owner": self.address}
+
+    def free_plasma(self, oids: List[ObjectID]):
+        if self.raylet is None:
+            return
+        try:
+            self.call_sync(self.raylet, "free_objects",
+                           {"object_ids": [o.hex() for o in oids]})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------- get
+
+    def get_objects(self, refs: List[ObjectRef],
+                    timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(ref, deadline) for ref in refs]
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
+
+    def _get_one(self, ref: ObjectRef, deadline) -> Any:
+        oid = ref.id()
+        while True:
+            # 1. in-process memory store
+            payload = self.memory_store.get(oid)
+            if payload is not None:
+                return self._deserialize_payload(oid, payload)
+            # 2. local plasma
+            buf = self.plasma.get_buffer(oid)
+            if buf is not None:
+                return self._deserialize_plasma(oid, buf)
+            # 3. ask the owner / locate
+            if not self._resolve_remote(ref, deadline):
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {oid}")
+
+    def _deserialize_payload(self, oid: ObjectID, payload: bytes) -> Any:
+        value = serialization.deserialize(payload)
+        if isinstance(value, _PlasmaIndirect):
+            # owner sent us a descriptor: the real value sits in plasma
+            self._ensure_local_plasma(oid, value, None)
+            buf = self.plasma.get_buffer(oid)
+            if buf is None:
+                raise exc.ObjectLostError(oid)
+            self.memory_store.delete(oid)
+            return self._deserialize_plasma(oid, buf)
+        return value
+
+    def _deserialize_plasma(self, oid: ObjectID, buf) -> Any:
+        try:
+            value = serialization.deserialize(buf)
+        except BaseException:
+            buf.release()
+            self.plasma.release(oid)
+            raise
+        # zero-copy values keep the store slot alive until GC'd
+        try:
+            weakref.finalize(value, _release_plasma, self.plasma, oid, buf)
+        except TypeError:
+            # not weakref-able: value cannot reference the buffer (envelope
+            # copies scalars), safe to release now
+            buf.release()
+            self.plasma.release(oid)
+        return value
+
+    def _resolve_remote(self, ref: ObjectRef, deadline) -> bool:
+        """Pull the object toward this process. True if progress was made."""
+        oid = ref.id()
+        owner = ref.owner_address()
+        timeout = self._remaining(deadline)
+        step = min(timeout, 2.0) if timeout is not None else 2.0
+        if owner and owner != self.address:
+            try:
+                conn = self.io.run(self._peer(owner))
+                r = self.call_sync(conn, "wait_object",
+                                   {"object_id": oid.hex(), "timeout": step},
+                                   timeout=step + 5)
+            except Exception:
+                r = None
+            if r and r.get("ready"):
+                if r.get("inline") is not None:
+                    self.memory_store.put(oid, r["inline"])
+                    return True
+                # plasma object on some node: fetch into local store
+                self._fetch_via_raylet(oid)
+                return True
+            if r is not None and not r.get("ready"):
+                if r.get("lost"):
+                    raise exc.ObjectLostError(oid, r.get("reason", ""))
+                if timeout is not None and timeout <= 0:
+                    return False
+                return True  # keep waiting
+            # owner unreachable
+            if self._try_locations(oid):
+                return True
+            raise exc.ObjectLostError(
+                oid, "owner is unreachable and no copies are registered "
+                     "(owner failure is fatal for its objects, as in the "
+                     "reference ownership model)")
+        # we are the owner (or owner unknown): wait on local delivery
+        state = self.pending_tasks.get(oid.task_id().hex())
+        if state is not None and not state.done:
+            state.result_event.wait(step)
+            return True
+        if self.memory_store.contains(oid) or self.plasma.contains(oid):
+            return True
+        if self._try_locations(oid):
+            return True
+        if self.mode == MODE_WORKER or not ref.owner_address():
+            # borrower without owner info — poll briefly
+            time.sleep(0.05)
+            return timeout is None or timeout > 0
+        return self._maybe_reconstruct(oid)
+
+    def _try_locations(self, oid: ObjectID) -> bool:
+        try:
+            r = self.call_sync(self.gcs, "get_object_locations",
+                               {"object_id": oid.hex()})
+        except Exception:
+            return False
+        if r.get("locations"):
+            self._fetch_via_raylet(oid)
+            return True
+        return False
+
+    def _fetch_via_raylet(self, oid: ObjectID):
+        if self.plasma.contains(oid):
+            return
+        if self.raylet is None:
+            raise exc.ObjectLostError(oid, "no raylet to fetch through")
+        self.call_sync(self.raylet, "fetch_object", {"object_id": oid.hex()})
+
+    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the creating task (reference:
+        object_recovery_manager.h RecoverObject → TaskManager::ResubmitTask)."""
+        spec = self.reference_counter.get_lineage(oid)
+        if spec is None:
+            raise exc.ObjectLostError(oid, "no lineage recorded")
+        logger.warning("reconstructing %s via lineage resubmit", oid)
+        self.submit_spec(spec, reconstruction=True)
+        return True
+
+    # ------------------------------------------------------------------ wait
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id()
+        if self.memory_store.contains(oid) or self.plasma.contains(oid):
+            return True
+        state = self.pending_tasks.get(oid.task_id().hex())
+        if state is not None:
+            return state.done
+        owner = ref.owner_address()
+        if owner and owner != self.address:
+            try:
+                conn = self.io.run(self._peer(owner))
+                r = self.call_sync(conn, "wait_object",
+                                   {"object_id": oid.hex(), "timeout": 0},
+                                   timeout=5)
+                return bool(r.get("ready"))
+            except Exception:
+                return False
+        return False
+
+    # ------------------------------------------------------------ submit task
+
+    def submit_task(self, fn_key: str, fn_name: str, args, kwargs,
+                    opts: Dict[str, Any]) -> List[ObjectRef]:
+        from ray_tpu.common.options import resource_dict_from_options
+        task_id = TaskID.for_task(self.current_task_id
+                                  or TaskID.for_driver(self.job_id))
+        num_returns = opts.get("num_returns")
+        if num_returns is None:
+            num_returns = 1
+        arg_blob, plasma_deps, arg_refs = self._serialize_args(args, kwargs)
+        spec = {
+            "task_id": task_id.hex(),
+            "fn_key": fn_key,
+            "fn_name": fn_name,
+            "args": arg_blob,
+            "plasma_deps": plasma_deps,
+            "arg_refs": arg_refs,
+            "num_returns": num_returns,
+            "owner_address": self.address,
+            "resources": resource_dict_from_options(opts, is_actor=False),
+            "runtime_env": opts.get("runtime_env"),
+            "scheduling": self._scheduling_from_opts(opts),
+            "placement_group": self._pg_from_opts(opts),
+            "max_retries": opts.get("max_retries",
+                                    self.config.task_max_retries_default),
+            "retry_exceptions": bool(opts.get("retry_exceptions")),
+        }
+        return self.submit_spec(spec)
+
+    def submit_spec(self, spec, reconstruction: bool = False) -> List[ObjectRef]:
+        task_id = TaskID(bytes.fromhex(spec["task_id"]))
+        num_returns = spec["num_returns"]
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(num_returns)]
+        state = PendingTaskState(spec, spec.get("max_retries", 0), return_ids)
+        self.pending_tasks[spec["task_id"]] = state
+        for oid in return_ids:
+            self.reference_counter.add_owned(oid, lineage=spec)
+        for hex_ref, _owner in spec.get("arg_refs", []):
+            self.reference_counter.add_submitted(ObjectID.from_hex(hex_ref))
+
+        def _submit_async():
+            async def _go():
+                try:
+                    reply = await self.raylet.call("submit_task", spec)
+                except Exception as e:
+                    reply = {"error": "RAYLET_UNREACHABLE", "message": str(e)}
+                self._on_submit_reply(state, reply)
+            self.io.run_async(_go())
+
+        _submit_async()
+        refs = [ObjectRef(oid, self.address) for oid in return_ids]
+        return refs
+
+    def _on_submit_reply(self, state: PendingTaskState, reply):
+        err = reply.get("error")
+        if err is None:
+            state.worker_address = reply.get("worker_address")
+            return
+        if err in ("WORKER_DIED", "WORKER_START_FAILED",
+                   "OBJECT_FETCH_FAILED", "RAYLET_UNREACHABLE") and \
+                state.retries_left != 0:
+            state.retries_left -= 1
+            logger.warning("task %s failed (%s), retrying (%d left)",
+                           state.spec["fn_name"], err, state.retries_left)
+
+            async def _resub():
+                try:
+                    reply = await self.raylet.call("submit_task", state.spec)
+                except Exception as e:
+                    reply = {"error": "RAYLET_UNREACHABLE", "message": str(e)}
+                self._on_submit_reply(state, reply)
+            self.io.run_async(_resub())
+            return
+        # fatal: store error into all return objects
+        e: Exception
+        if err == "CANCELLED":
+            e = exc.TaskCancelledError(state.spec["task_id"])
+        elif err == "WORKER_DIED":
+            e = exc.WorkerCrashedError(reply.get("message", ""))
+        else:
+            e = exc.RayTpuError(f"{err}: {reply.get('message', '')}")
+        ser = serialization.serialize_error(e)
+        payload = ser.to_bytes()
+        for oid in state.return_ids:
+            self.memory_store.put(oid, payload)
+        state.done = True
+        state.result_event.set()
+
+    def _serialize_args(self, args, kwargs):
+        """Serialize task args. Large arg values are promoted to plasma
+        objects (implicit put) so they ride the object plane; refs are listed
+        as dependencies for the executing raylet to pre-fetch."""
+        promoted_args = []
+        for a in args:
+            promoted_args.append(self._promote_arg(a))
+        promoted_kwargs = {k: self._promote_arg(v) for k, v in kwargs.items()}
+        ser = serialization.serialize((promoted_args, promoted_kwargs))
+        arg_refs = list(ser.contained_refs)
+        plasma_deps = []
+        for hex_ref, owner in arg_refs:
+            oid = ObjectID.from_hex(hex_ref)
+            e = self.reference_counter.table.get(oid)
+            if (e and e.get("in_plasma")) or self.plasma.contains(oid):
+                plasma_deps.append(hex_ref)
+        return ser.to_bytes(), plasma_deps, arg_refs
+
+    def _promote_arg(self, value):
+        if isinstance(value, ObjectRef):
+            return value
+        try:
+            import numpy as np
+            if isinstance(value, np.ndarray) and \
+                    value.nbytes > self.config.max_inline_object_size:
+                return self.put_object(value)
+        except ImportError:
+            pass
+        return value
+
+    @staticmethod
+    def _scheduling_from_opts(opts) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        strategy = opts.get("scheduling_strategy")
+        if strategy == "SPREAD":
+            out["spread"] = True
+        elif strategy is not None and not isinstance(strategy, str):
+            # NodeAffinitySchedulingStrategy / PlacementGroup strategy objects
+            node_id = getattr(strategy, "node_id", None)
+            if node_id is not None:
+                out["node_id"] = node_id
+                out["soft"] = getattr(strategy, "soft", False)
+        if opts.get("tpu_topology"):
+            out["tpu_topology"] = opts["tpu_topology"]
+        return out
+
+    @staticmethod
+    def _pg_from_opts(opts) -> Optional[Dict[str, Any]]:
+        strategy = opts.get("scheduling_strategy")
+        pg = getattr(strategy, "placement_group", None)
+        if pg is None:
+            return None
+        return {"pg_id": pg.id_hex,
+                "bundle_index": getattr(strategy,
+                                        "placement_group_bundle_index", 0)}
+
+    # --------------------------------------------------- result delivery (owner)
+
+    async def _h_task_result(self, payload, conn):
+        task_hex = payload["task_id"]
+        state = self.pending_tasks.get(task_hex)
+        for ret in payload["returns"]:
+            oid = ObjectID.from_hex(ret["object_id"])
+            if ret.get("inline") is not None:
+                self.memory_store.put(oid, ret["inline"])
+            else:
+                # descriptor: value lives in plasma (possibly another node)
+                ind = _PlasmaIndirect(ret.get("node_id", ""))
+                ser = serialization.serialize(ind)
+                if not self.plasma.contains(oid):
+                    self.memory_store.put(oid, ser.to_bytes())
+        if state is not None:
+            if payload.get("app_error") and state.retries_left != 0 and \
+                    state.spec.get("retry_exceptions"):
+                state.retries_left -= 1
+                asyncio.get_running_loop().create_task(
+                    self._retry(state))
+                return {}
+            state.done = True
+            state.result_event.set()
+            for hex_ref, _ in state.spec.get("arg_refs", []):
+                self.reference_counter.remove_submitted(
+                    ObjectID.from_hex(hex_ref))
+        return {}
+
+    async def _retry(self, state):
+        try:
+            reply = await self.raylet.call("submit_task", state.spec)
+        except Exception as e:
+            reply = {"error": "RAYLET_UNREACHABLE", "message": str(e)}
+        self._on_submit_reply(state, reply)
+
+    async def _h_wait_object(self, payload, conn):
+        """Owner-side long poll: is this object ready? (borrowers call this)"""
+        oid = ObjectID.from_hex(payload["object_id"])
+        timeout = payload.get("timeout", 0)
+        payload_bytes = self.memory_store.get(oid)
+        if payload_bytes is None and not self.plasma.contains(oid):
+            state = self.pending_tasks.get(oid.task_id().hex())
+            if state is not None and not state.done and timeout:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, state.result_event.wait, timeout)
+            payload_bytes = self.memory_store.get(oid)
+        if payload_bytes is not None:
+            value = None
+            try:
+                value = serialization.deserialize(payload_bytes)
+            except BaseException:
+                pass  # error envelope: still ship it raw
+            if isinstance(value, _PlasmaIndirect):
+                return {"ready": True, "plasma": True,
+                        "node_id": value.node_id}
+            return {"ready": True, "inline": payload_bytes}
+        if self.plasma.contains(oid):
+            return {"ready": True, "plasma": True, "node_id": self.node_id}
+        return {"ready": False}
+
+    async def _h_borrow_add(self, payload, conn):
+        self.reference_counter.on_borrow_add(payload["object_id"],
+                                             payload["borrower"])
+        return {}
+
+    async def _h_borrow_del(self, payload, conn):
+        self.reference_counter.on_borrow_del(payload["object_id"],
+                                             payload["borrower"])
+        return {}
+
+    async def _h_ping(self, payload, conn):
+        return {"worker_id": self.worker_id.hex(), "mode": self.mode}
+
+    async def _h_exit_worker(self, payload, conn):
+        os._exit(0)
+
+    # ----------------------------------------------------- task execution side
+
+    async def _h_push_task(self, payload, conn):
+        self._task_queue.put(payload)
+        return {}
+
+    async def _h_cancel_task(self, payload, conn):
+        self._cancelled_tasks.add(payload["task_id"])
+        return {}
+
+    def task_execution_loop(self):
+        """Main loop of a worker process (reference:
+        core_worker.cc:2180 RunTaskExecutionLoop → task_execution_handler)."""
+        while True:
+            item = self._task_queue.get()
+            if item is None:
+                break
+            self._execute_task(item["spec"], item.get("tpu_chips") or [])
+
+    def _execute_task(self, spec, tpu_chips):
+        task_hex = spec["task_id"]
+        self.current_task_id = TaskID(bytes.fromhex(task_hex))
+        self.tpu_chips = tpu_chips
+        owner = spec["owner_address"]
+        returns = []
+        app_error = False
+        try:
+            if task_hex in self._cancelled_tasks:
+                raise exc.TaskCancelledError(task_hex)
+            fn = self.function_manager.fetch(spec["fn_key"])
+            args, kwargs = serialization.deserialize(spec["args"])
+            args = [self._resolve_arg(a) for a in args]
+            kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            result = fn(*args, **kwargs)
+            num_returns = spec["num_returns"]
+            if num_returns == 1:
+                values = [result]
+            elif num_returns == 0:
+                values = []
+            else:
+                values = list(result)
+                if len(values) != num_returns:
+                    raise ValueError(
+                        f"task declared num_returns={num_returns} but "
+                        f"returned {len(values)} values")
+            for i, v in enumerate(values):
+                oid = ObjectID.for_return(self.current_task_id, i)
+                ser = serialization.serialize(v)
+                returns.append(self._ship_return(oid, ser))
+        except BaseException as e:  # noqa: BLE001
+            logger.debug("task %s raised: %s", spec["fn_name"],
+                         traceback.format_exc())
+            app_error = True
+            err = exc.TaskError.capture(spec["fn_name"], e) \
+                if not isinstance(e, exc.RayTpuError) else e
+            ser = serialization.serialize_error(err)
+            for i in range(max(1, spec["num_returns"])):
+                oid = ObjectID.for_return(self.current_task_id, i)
+                returns.append({"object_id": oid.hex(),
+                                "inline": ser.to_bytes()})
+        finally:
+            self.current_task_id = None
+        self.try_notify(owner, "task_result", {
+            "task_id": task_hex, "returns": returns, "app_error": app_error})
+        if self.raylet is not None:
+            self.io.run_async(self.raylet.call("task_done",
+                                               {"task_id": task_hex}))
+
+    def _ship_return(self, oid: ObjectID, ser) -> Dict[str, Any]:
+        if ser.total_size <= self.config.max_inline_object_size:
+            return {"object_id": oid.hex(), "inline": ser.to_bytes()}
+        buf = self.plasma.create(oid, ser.total_size)
+        ser.write_into(buf)
+        buf.release()
+        self.plasma.seal(oid)
+        if self.raylet is not None:
+            try:
+                self.call_sync(self.raylet, "pin_object",
+                               {"object_id": oid.hex()})
+            except Exception:
+                pass
+        return {"object_id": oid.hex(), "plasma": True,
+                "node_id": self.node_id}
+
+    def _resolve_arg(self, value):
+        if isinstance(value, ObjectRef):
+            return self._get_one(value, deadline=None)
+        return value
+
+    # -------------------------------------------------------------- actor side
+
+    async def _h_become_actor(self, payload, conn):
+        spec = payload["create_spec"]
+        self.tpu_chips = payload.get("tpu_chips") or []
+        loop = asyncio.get_running_loop()
+        err = await loop.run_in_executor(None, self._init_actor, spec)
+        if err is not None:
+            raise protocol.RpcError(err)
+        return {}
+
+    def _init_actor(self, spec) -> Optional[str]:
+        try:
+            cls = self.function_manager.fetch(spec["class_key"])
+            args, kwargs = serialization.deserialize(spec["init_args"])
+            args = [self._resolve_arg(a) for a in args]
+            kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            self.current_actor_id = ActorID(bytes.fromhex(spec["actor_id"]))
+            self.current_task_id = TaskID.for_actor_task(
+                self.current_actor_id, 0)
+            max_concurrency = spec.get("max_concurrency") or 1
+            self._actor_threads = ThreadPoolExecutor(
+                max_workers=max_concurrency,
+                thread_name_prefix="actor-exec")
+            self._actor_instance = cls(*args, **kwargs)
+            self.mode = MODE_WORKER
+            return None
+        except BaseException as e:  # noqa: BLE001
+            logger.error("actor init failed: %s", traceback.format_exc())
+            return f"{type(e).__name__}: {e}"
+
+    async def _h_actor_call(self, payload, conn):
+        loop = asyncio.get_running_loop()
+        method_name = payload["method"]
+        inst = self._actor_instance
+        if inst is None:
+            raise protocol.RpcError("not an actor worker")
+        method = getattr(inst, method_name, None)
+        if method is None:
+            raise protocol.RpcError(
+                f"{type(inst).__name__} has no method {method_name}")
+
+        def _run():
+            seq = TaskID(bytes.fromhex(payload["task_id"]))
+            try:
+                args, kwargs = serialization.deserialize(payload["args"])
+                args = [self._resolve_arg(a) for a in args]
+                kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+                result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = asyncio.run(result)
+                ser = serialization.serialize(result)
+                oid = ObjectID.for_return(seq, 0)
+                return self._ship_return(oid, ser)
+            except BaseException as e:  # noqa: BLE001
+                err = exc.ActorError.capture(
+                    f"{type(inst).__name__}.{method_name}", e)
+                ser = serialization.serialize_error(err)
+                oid = ObjectID.for_return(seq, 0)
+                return {"object_id": oid.hex(), "inline": ser.to_bytes(),
+                        "app_error": True}
+
+        return await loop.run_in_executor(self._actor_threads, _run)
+
+
+class _PlasmaIndirect:
+    """Marker stored in a memory store slot: the value is in plasma."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+
+def _release_plasma(plasma: PlasmaxStore, oid: ObjectID, buf):
+    try:
+        buf.release()
+        plasma.release(oid)
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Module-level convenience used by the public API
+
+def get(ref_or_refs, *, timeout: Optional[float] = None):
+    w = global_worker()
+    if isinstance(ref_or_refs, ObjectRef):
+        return w.get_objects([ref_or_refs], timeout)[0]
+    if isinstance(ref_or_refs, list):
+        return w.get_objects(ref_or_refs, timeout)
+    raise TypeError("get() expects an ObjectRef or a list of ObjectRefs")

@@ -1,0 +1,289 @@
+"""Actor API: ``@ray_tpu.remote`` classes, handles, and the actor transport.
+
+Role-equivalent to the reference's python/ray/actor.py (ActorClass._remote
+:657, ActorMethod._remote :161) over the direct actor transport
+(core_worker/transport/direct_actor_task_submitter.cc): after creation, method
+calls go *directly* to the actor's worker process over a peer connection with
+no raylet involvement; the GCS only mediates creation, restarts, and naming
+(gcs_actor_manager.cc semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu._private.worker import (ObjectRef, PendingTaskState,
+                                     global_worker)
+from ray_tpu.common.ids import ActorID, ObjectID, TaskID
+from ray_tpu.common.options import (resource_dict_from_options,
+                                    validate_options)
+from ray_tpu import exceptions as exc
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._name = name
+        self._options = options or {}
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._handle._remote_call(self._name, args, kwargs,
+                                         self._options)
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, opts)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor methods cannot be called directly; use "
+            f".{self._name}.remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+        self._worker_address: Optional[str] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._dead_reason: Optional[str] = None
+
+    @property
+    def _id_hex(self) -> str:
+        return self._actor_id.hex()
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._max_task_retries))
+
+    # ------------------------------------------------------------------ calls
+
+    def _resolve_address(self, timeout: float = 120.0) -> str:
+        w = global_worker()
+        if self._worker_address:
+            return self._worker_address
+        info = w.call_sync(w.gcs, "wait_actor_alive",
+                           {"actor_id": self._id_hex, "timeout": timeout},
+                           timeout=timeout + 5)
+        if info.get("error"):
+            raise exc.ActorDiedError(self._id_hex, info["error"])
+        if info["state"] == "DEAD":
+            raise exc.ActorDiedError(self._id_hex,
+                                     info.get("death_cause") or "dead")
+        self._worker_address = info["worker_address"]
+        return self._worker_address
+
+    def _remote_call(self, method: str, args, kwargs,
+                     opts: Dict[str, Any]) -> ObjectRef:
+        w = global_worker()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        task_id = TaskID.for_task(w.current_task_id
+                                  or TaskID.for_driver(w.job_id))
+        ser = serialization.serialize((list(args), kwargs))
+        payload = {
+            "task_id": task_id.hex(),
+            "method": method,
+            "args": ser.to_bytes(),
+            "seq": seq,
+            "caller": w.address,
+        }
+        oid = ObjectID.for_return(task_id, 0)
+        state = PendingTaskState({"task_id": task_id.hex(),
+                                  "fn_name": f"{self._class_name}.{method}",
+                                  "arg_refs": ser.contained_refs},
+                                 self._max_task_retries, [oid])
+        w.pending_tasks[task_id.hex()] = state
+        w.reference_counter.add_owned(oid)
+
+        async def _call(attempt: int = 0):
+            try:
+                addr = await _to_thread(self._resolve_address)
+                conn = await w._peer(addr)
+                ret = await conn.call("actor_call", payload)
+                _store_actor_result(w, state, ret)
+            except exc.ActorDiedError as e:
+                _store_actor_error(w, state, e)
+            except Exception as e:  # connection error → maybe restart
+                self._worker_address = None
+                info = None
+                try:
+                    info = await w.gcs.call(
+                        "get_actor", {"actor_id": self._id_hex})
+                except Exception:
+                    pass
+                restartable = info and info.get("state") in (
+                    "RESTARTING", "PENDING_CREATION", "ALIVE")
+                if restartable and (self._max_task_retries == -1
+                                    or attempt < max(self._max_task_retries, 0)):
+                    await _to_thread(time.sleep, 0.2)
+                    await _call(attempt + 1)
+                elif restartable and self._max_task_retries == 0:
+                    _store_actor_error(
+                        w, state, exc.ActorUnavailableError(
+                            f"actor {self._id_hex[:8]} restarting; call not "
+                            f"retried (max_task_retries=0): {e}"))
+                else:
+                    reason = (info or {}).get("death_cause") or str(e)
+                    _store_actor_error(
+                        w, state, exc.ActorDiedError(self._id_hex, reason))
+
+        w.io.run_async(_call())
+        return ObjectRef(oid, w.address)
+
+
+async def _to_thread(fn, *args):
+    import asyncio
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+
+def _store_actor_result(w, state: PendingTaskState, ret: Dict[str, Any]):
+    oid = ObjectID.from_hex(ret["object_id"])
+    target = state.return_ids[0]
+    if ret.get("inline") is not None:
+        w.memory_store.put(target, ret["inline"])
+    else:
+        ind = worker_mod._PlasmaIndirect(ret.get("node_id", ""))
+        # the actor shipped the value under its own oid; alias it
+        if oid != target:
+            w.memory_store.put(target,
+                               serialization.serialize(ind).to_bytes())
+        else:
+            w.memory_store.put(target,
+                               serialization.serialize(ind).to_bytes())
+    state.done = True
+    state.result_event.set()
+
+
+def _store_actor_error(w, state: PendingTaskState, e: Exception):
+    payload = serialization.serialize_error(e).to_bytes()
+    for oid in state.return_ids:
+        w.memory_store.put(oid, payload)
+    state.done = True
+    state.result_event.set()
+
+
+class ActorClass:
+    """Result of decorating a class with ``@ray_tpu.remote``."""
+
+    def __init__(self, cls, default_opts: Dict[str, Any]):
+        self._cls = cls
+        self._default_opts = validate_options(default_opts, is_actor=True)
+        self._class_key: Optional[str] = None
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actors must be created with {self._cls.__name__}.remote()")
+
+    def options(self, **opts) -> "_BoundActorClass":
+        merged = {**self._default_opts, **validate_options(opts, is_actor=True)}
+        return _BoundActorClass(self, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._create(self._default_opts, args, kwargs)
+
+    def bind(self, *args, **kwargs):
+        """DAG authoring (reference: python/ray/dag ClassNode)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs, self._default_opts)
+
+    def _create(self, opts: Dict[str, Any], args, kwargs) -> ActorHandle:
+        w = global_worker()
+        if self._class_key is None:
+            self._class_key = w.function_manager.export(self._cls, kind="cls")
+        actor_id = ActorID.of(w.job_id)
+        ser = serialization.serialize((list(args), kwargs))
+        resources = resource_dict_from_options(opts, is_actor=True)
+        sched = w._scheduling_from_opts(opts)
+        pg = w._pg_from_opts(opts)
+        create_spec = {
+            "actor_id": actor_id.hex(),
+            "class_key": self._class_key,
+            "class_name": self._cls.__name__,
+            "init_args": ser.to_bytes(),
+            "max_concurrency": opts.get("max_concurrency", 1),
+            "runtime_env": opts.get("runtime_env"),
+            "placement_group": pg,
+        }
+        reg = w.call_sync(w.gcs, "register_actor", {
+            "actor_id": actor_id.hex(),
+            "name": opts.get("name"),
+            "namespace": opts.get("namespace", w.namespace),
+            "class_name": self._cls.__name__,
+            "owner_address": w.address,
+            "detached": opts.get("lifetime") == "detached",
+            "resources": resources,
+            "max_restarts": opts.get(
+                "max_restarts", w.config.actor_max_restarts_default),
+            "scheduling": sched,
+            "get_if_exists": opts.get("get_if_exists", False),
+            "create_spec": create_spec,
+        })
+        if reg.get("error"):
+            raise ValueError(reg["error"])
+        if reg.get("existing"):
+            return get_actor_by_id(reg["actor_id"])
+        w.call_sync(w.gcs, "create_actor", {
+            "actor_id": actor_id.hex(), "create_spec": create_spec})
+        return ActorHandle(actor_id, self._cls.__name__,
+                           opts.get("max_task_retries", 0))
+
+
+class _BoundActorClass:
+    def __init__(self, actor_class: ActorClass, opts: Dict[str, Any]):
+        self._actor_class = actor_class
+        self._opts = opts
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._actor_class._create(self._opts, args, kwargs)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self._actor_class, args, kwargs, self._opts)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    w = global_worker()
+    info = w.call_sync(w.gcs, "get_named_actor", {
+        "name": name, "namespace": namespace if namespace is not None
+        else w.namespace})
+    if info.get("error"):
+        raise ValueError(info["error"])
+    handle = ActorHandle(ActorID.from_hex(info["actor_id"]),
+                         info.get("class_name", ""))
+    if info.get("worker_address"):
+        handle._worker_address = info["worker_address"]
+    return handle
+
+
+def get_actor_by_id(actor_id_hex: str) -> ActorHandle:
+    w = global_worker()
+    info = w.call_sync(w.gcs, "get_actor", {"actor_id": actor_id_hex})
+    if info.get("error"):
+        raise ValueError(info["error"])
+    handle = ActorHandle(ActorID.from_hex(actor_id_hex),
+                         info.get("class_name", ""))
+    if info.get("worker_address"):
+        handle._worker_address = info["worker_address"]
+    return handle
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    w = global_worker()
+    w.call_sync(w.gcs, "kill_actor", {"actor_id": actor._id_hex,
+                                      "no_restart": no_restart})

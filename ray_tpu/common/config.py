@@ -1,0 +1,103 @@
+"""System config registry, env-var overridable.
+
+Equivalent in role to the reference's RAY_CONFIG system
+(reference: src/ray/common/ray_config_def.h — 184 entries, each overridable by
+``RAY_<name>`` env var or ``ray.init(_system_config=...)``). Here every entry is
+declared once with a type and default, overridable by ``RTPU_<NAME>`` env vars
+or ``ray_tpu.init(_system_config={...})``; the head process snapshots the
+resolved config and distributes it to every worker via the control-plane
+handshake so all processes agree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+
+def _env(name: str, typ, default):
+    raw = os.environ.get(f"RTPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclass
+class SystemConfig:
+    # ---- object store ----
+    object_store_memory_bytes: int = 2 * 1024**3
+    # objects smaller than this are inlined in the in-process memory store and
+    # carried through the control plane rather than the shm store (analogue of
+    # the reference's max_direct_call_object_size, ray_config_def.h)
+    max_inline_object_size: int = 100 * 1024
+    object_spilling_threshold: float = 0.8
+    object_store_fallback_dir: str = ""
+    # ---- scheduler ----
+    scheduler_spread_threshold: float = 0.5
+    worker_lease_timeout_s: float = 30.0
+    max_pending_lease_requests_per_key: int = 10
+    # ---- workers ----
+    num_workers_soft_limit: int = -1  # -1: num_cpus
+    idle_worker_kill_s: float = 300.0
+    worker_start_timeout_s: float = 60.0
+    prestart_workers: bool = True
+    # ---- fault tolerance ----
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    lineage_max_bytes: int = 1024**3
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    # ---- control plane ----
+    gcs_port: int = 0  # 0 = auto
+    rpc_connect_timeout_s: float = 10.0
+    pubsub_poll_timeout_s: float = 30.0
+    # ---- TPU ----
+    tpu_chips_per_host: int = -1  # -1: autodetect
+    tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
+    # persistent XLA compilation cache shared across workers (no reference
+    # analogue; new subsystem per SURVEY.md §7 "Compilation management")
+    compilation_cache_dir: str = ""
+    # ---- metrics/events ----
+    metrics_report_period_s: float = 5.0
+    event_log_enabled: bool = True
+
+    def apply_env_overrides(self):
+        for f in fields(self):
+            cur = getattr(self, f.name)
+            setattr(self, f.name, _env(f.name, type(cur), cur))
+        return self
+
+    def update(self, overrides: Dict[str, Any]):
+        for k, v in (overrides or {}).items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown system config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, s: str) -> "SystemConfig":
+        cfg = cls()
+        cfg.update(json.loads(s))
+        return cfg
+
+
+_global_config: SystemConfig | None = None
+
+
+def global_config() -> SystemConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = SystemConfig().apply_env_overrides()
+    return _global_config
+
+
+def set_global_config(cfg: SystemConfig):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,64 @@
+"""``@ray_tpu.remote`` functions.
+
+Reference analogue: python/ray/remote_function.py (RemoteFunction._remote:239
+→ core_worker.submit_task:385). The function is exported to GCS KV once and
+referenced by key in every task spec.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.worker import ObjectRef, global_worker
+from ray_tpu.common.options import validate_options
+
+
+class RemoteFunction:
+    def __init__(self, fn, default_opts: Dict[str, Any]):
+        self._fn = fn
+        self._default_opts = validate_options(default_opts, is_actor=False)
+        self._fn_key: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called "
+            f"directly; use .remote()")
+
+    def options(self, **opts) -> "_BoundRemoteFunction":
+        merged = {**self._default_opts,
+                  **validate_options(opts, is_actor=False)}
+        return _BoundRemoteFunction(self, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_opts)
+
+    def bind(self, *args, **kwargs):
+        """DAG authoring (reference: python/ray/dag FunctionNode)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs, self._default_opts)
+
+    def _remote(self, args, kwargs, opts: Dict[str, Any]):
+        w = global_worker()
+        if self._fn_key is None:
+            self._fn_key = w.function_manager.export(self._fn, kind="fn")
+        refs = w.submit_task(self._fn_key, self._fn.__name__, args, kwargs,
+                             opts)
+        num_returns = opts.get("num_returns")
+        if num_returns is None or num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class _BoundRemoteFunction:
+    def __init__(self, remote_fn: RemoteFunction, opts: Dict[str, Any]):
+        self._remote_fn = remote_fn
+        self._opts = opts
+
+    def remote(self, *args, **kwargs):
+        return self._remote_fn._remote(args, kwargs, self._opts)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self._remote_fn, args, kwargs, self._opts)

@@ -1,0 +1,11 @@
+"""Minimal chrome-trace event collection (fleshed out with the state API)."""
+_events = []
+
+
+def record(name, ph, ts, pid=0, tid=0, **kw):
+    _events.append({"name": name, "ph": ph, "ts": ts, "pid": pid,
+                    "tid": tid, **kw})
+
+
+def collect():
+    return list(_events)
