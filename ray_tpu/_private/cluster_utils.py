@@ -1,0 +1,104 @@
+"""In-process multi-node test cluster.
+
+Reference analogue: python/ray/cluster_utils.py (Cluster:99 / add_node:165) —
+multiple raylets run as separate processes on one machine sharing one GCS;
+this is how multi-node behavior (spillback, PGs, object transfer, node death)
+is tested without a real cluster (SURVEY.md §4). On a TPU host, chips are
+partitioned between simulated nodes via the TPU resource quantity.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import node as node_mod
+from ray_tpu.common.config import SystemConfig
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[Dict[str, Any]] = None,
+                 connect: bool = False,
+                 _system_config: Optional[Dict[str, Any]] = None):
+        self.config = SystemConfig().apply_env_overrides()
+        if _system_config:
+            self.config.update(_system_config)
+        self.session_dir = node_mod.new_session_dir()
+        self.head: Optional[node_mod.NodeProcesses] = None
+        self.worker_nodes: List[Dict[str, Any]] = []
+        self.gcs_address = ""
+        if initialize_head:
+            args = head_node_args or {}
+            self.head = node_mod.start_head(
+                self.config,
+                resources=self._res(args),
+                labels=args.get("labels"),
+                object_store_memory=args.get("object_store_memory"),
+                session_dir=self.session_dir)
+            self.gcs_address = self.head.gcs_address
+        if connect:
+            self.connect()
+
+    @staticmethod
+    def _res(args: Dict[str, Any]) -> Dict[str, float]:
+        res = dict(args.get("resources", {}))
+        if "num_cpus" in args:
+            res["CPU"] = float(args["num_cpus"])
+        if "num_tpus" in args:
+            res["TPU"] = float(args["num_tpus"])
+        if "num_gpus" in args:
+            res["GPU"] = float(args["num_gpus"])
+        return res
+
+    @property
+    def address(self) -> str:
+        return self.gcs_address
+
+    def add_node(self, **args) -> Dict[str, Any]:
+        info = node_mod.add_node(
+            self.session_dir, self.gcs_address,
+            resources=self._res(args),
+            labels=args.get("labels"),
+            object_store_memory=args.get("object_store_memory"))
+        self.worker_nodes.append(info)
+        return info
+
+    def remove_node(self, info: Dict[str, Any], allow_graceful: bool = False):
+        proc = info["proc"]
+        if allow_graceful:
+            proc.terminate()
+        else:
+            proc.kill()
+        proc.wait(timeout=10)
+        if info in self.worker_nodes:
+            self.worker_nodes.remove(info)
+
+    def connect(self, namespace: str = ""):
+        import ray_tpu
+        os.environ["RTPU_SESSION_DIR"] = self.session_dir
+        ray_tpu.init(address=self.gcs_address, namespace=namespace)
+
+    def wait_for_nodes(self, timeout: float = 30.0):
+        import ray_tpu
+        expected = 1 + len(self.worker_nodes) if self.head else \
+            len(self.worker_nodes)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["alive"]]
+            if len(alive) >= expected:
+                return
+            time.sleep(0.1)
+        raise TimeoutError("nodes did not come up")
+
+    def shutdown(self):
+        import ray_tpu
+        ray_tpu.shutdown()
+        for info in self.worker_nodes:
+            try:
+                info["proc"].kill()
+            except Exception:
+                pass
+        if self.head is not None:
+            self.head.kill_all()

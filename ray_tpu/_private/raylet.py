@@ -334,10 +334,19 @@ class Raylet:
                 _, ptask = entry
                 self._release_resources(ptask, handle.tpu_chips)
                 handle.tpu_chips = ()
+                msg = {"error": "WORKER_DIED",
+                       "message": f"worker {worker_id} died: {reason}"}
                 if ptask.reply_fut is not None and not ptask.reply_fut.done():
-                    ptask.reply_fut.set_result(
-                        {"error": "WORKER_DIED",
-                         "message": f"worker {worker_id} died: {reason}"})
+                    ptask.reply_fut.set_result(msg)
+                else:
+                    # dispatch already replied; the owner is waiting on a
+                    # task_result that will never come — tell it directly
+                    owner = ptask.spec.get("owner_address")
+                    task_id = ptask.spec.get("task_id")
+                    if owner and task_id:
+                        asyncio.get_running_loop().create_task(
+                            self._notify_owner_task_failed(
+                                owner, task_id, msg))
         if handle.is_actor and handle.actor_id and self.gcs is not None:
             try:
                 await self.gcs.call("actor_state_update", {
@@ -346,6 +355,17 @@ class Raylet:
             except Exception:
                 pass
         self._dispatch_event.set()
+
+    async def _notify_owner_task_failed(self, owner: str, task_id: str,
+                                        msg: Dict[str, Any]):
+        try:
+            conn = await protocol.connect(owner)
+            try:
+                await conn.notify("task_failed", {"task_id": task_id, **msg})
+            finally:
+                conn.close()
+        except Exception:
+            pass
 
     async def _idle_reaper_loop(self):
         while not self._shutdown:

@@ -370,6 +370,7 @@ class Worker:
     def _handlers(self):
         return {
             "task_result": self._h_task_result,
+            "task_failed": self._h_task_failed,
             "push_task": self._h_push_task,
             "become_actor": self._h_become_actor,
             "actor_call": self._h_actor_call,
@@ -459,11 +460,20 @@ class Worker:
                 "owner": self.address}
 
     def free_plasma(self, oids: List[ObjectID]):
-        if self.raylet is None:
+        """Fire-and-forget: may be called from ANY thread, including the IO
+        loop itself (refcounts hit zero inside result handlers), so this must
+        never block on the loop."""
+        if self.raylet is None or self.io is None:
             return
+
+        async def _go():
+            try:
+                await self.raylet.call(
+                    "free_objects", {"object_ids": [o.hex() for o in oids]})
+            except Exception:
+                pass
         try:
-            self.call_sync(self.raylet, "free_objects",
-                           {"object_ids": [o.hex() for o in oids]})
+            self.io.run_async(_go())
         except Exception:
             pass
 
@@ -562,7 +572,7 @@ class Worker:
         state = self.pending_tasks.get(oid.task_id().hex())
         if state is not None and not state.done:
             state.result_event.wait(step)
-            return True
+            return timeout is None or self._remaining(deadline) > 0
         if self.memory_store.contains(oid) or self.plasma.contains(oid):
             return True
         if self._try_locations(oid):
@@ -681,8 +691,11 @@ class Worker:
         self.pending_tasks[spec["task_id"]] = state
         for oid in return_ids:
             self.reference_counter.add_owned(oid, lineage=spec)
-        for hex_ref, _owner in spec.get("arg_refs", []):
-            self.reference_counter.add_submitted(ObjectID.from_hex(hex_ref))
+        if reconstruction:
+            # the original submission's counts were already removed on the
+            # first completion; count the resubmit's arg refs again
+            for hex_ref, _owner in spec.get("arg_refs", []):
+                self.reference_counter.add_submitted(ObjectID.from_hex(hex_ref))
 
         def _submit_async():
             async def _go():
@@ -729,6 +742,8 @@ class Worker:
         payload = ser.to_bytes()
         for oid in state.return_ids:
             self.memory_store.put(oid, payload)
+        for hex_ref, _ in state.spec.get("arg_refs", []):
+            self.reference_counter.remove_submitted(ObjectID.from_hex(hex_ref))
         state.done = True
         state.result_event.set()
 
@@ -742,6 +757,10 @@ class Worker:
         promoted_kwargs = {k: self._promote_arg(v) for k, v in kwargs.items()}
         ser = serialization.serialize((promoted_args, promoted_kwargs))
         arg_refs = list(ser.contained_refs)
+        # Count submitted-task references NOW, before promoted ObjectRefs can
+        # be GC'd (the matching remove_submitted runs at task completion).
+        for hex_ref, _owner in arg_refs:
+            self.reference_counter.add_submitted(ObjectID.from_hex(hex_ref))
         plasma_deps = []
         for hex_ref, owner in arg_refs:
             oid = ObjectID.from_hex(hex_ref)
@@ -815,6 +834,14 @@ class Worker:
             for hex_ref, _ in state.spec.get("arg_refs", []):
                 self.reference_counter.remove_submitted(
                     ObjectID.from_hex(hex_ref))
+        return {}
+
+    async def _h_task_failed(self, payload, conn):
+        """The raylet reports the executing worker died mid-task."""
+        state = self.pending_tasks.get(payload["task_id"])
+        if state is None or state.done:
+            return {}
+        self._on_submit_reply(state, payload)
         return {}
 
     async def _retry(self, state):

@@ -118,9 +118,10 @@ def resource_dict_from_options(opts: Dict[str, Any], is_actor: bool) -> Dict[str
     res: Dict[str, float] = {}
     num_cpus = opts.get("num_cpus")
     if num_cpus is None:
-        # Actors default to 1 CPU for placement but 0 for running (simplified:
-        # we charge 1 CPU to actors and tasks alike unless told otherwise).
-        num_cpus = 1 if not is_actor else 1
+        # Reference semantics: tasks default to 1 CPU; actors require 1 CPU
+        # for placement but hold 0 while running, so long-lived actors don't
+        # starve the node (python/ray/_private/ray_option_utils.py defaults).
+        num_cpus = 0 if is_actor else 1
     if num_cpus:
         res["CPU"] = float(num_cpus)
     for key, name in (("num_gpus", "GPU"), ("num_tpus", "TPU"), ("memory", "memory")):

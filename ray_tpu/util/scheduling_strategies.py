@@ -1,0 +1,42 @@
+"""User-facing scheduling strategies.
+
+Reference analogue: python/ray/util/scheduling_strategies.py
+(PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy). The
+TPU-first addition is SliceSchedulingStrategy: constrain onto hosts of one
+TPU slice so gang workers share an ICI domain.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = \
+            0 if placement_group_bundle_index < 0 \
+            else placement_group_bundle_index
+        self.placement_group_capture_child_tasks = \
+            placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class SliceSchedulingStrategy:
+    """Schedule onto any host of a TPU slice with the given topology
+    (e.g. 'v5e-8'); gang members sharing a slice get ICI connectivity."""
+
+    def __init__(self, topology: str, slice_name: Optional[str] = None):
+        self.topology = topology
+        self.slice_name = slice_name
+
+
+SPREAD = "SPREAD"
+DEFAULT = "DEFAULT"

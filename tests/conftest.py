@@ -1,0 +1,57 @@
+"""Test fixtures.
+
+Reference analogue: python/ray/tests/conftest.py (ray_start_regular:245,
+ray_start_cluster:326). JAX tests run on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) so multi-chip sharding logic is
+exercised without TPU hardware (SURVEY.md environment notes).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RTPU_PRESTART_WORKERS", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="function")
+def ray_start_regular():
+    import ray_tpu
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-scoped cluster for cheap tests (worker startup is ~1s/proc on
+    the 1-core CI box, so most tests share one cluster)."""
+    import ray_tpu
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=256 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="function")
+def ray_start_cluster():
+    from ray_tpu._private.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    return Mesh(np.array(devices[:8]).reshape(2, 4), ("dp", "tp"))

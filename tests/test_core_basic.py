@@ -1,0 +1,185 @@
+"""Core API tests: tasks, objects, actors, options.
+
+Mirrors the reference's python/ray/tests/test_basic.py coverage tier.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_put_get(ray_start_shared):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3], "b": "x"})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_numpy_zero_copy(ray_start_shared):
+    arr = np.arange(500_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=10)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_simple_task(ray_start_shared):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+
+
+def test_task_chaining(ray_start_shared):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ref = f.remote(1)
+    for _ in range(4):
+        ref = f.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 32
+
+
+def test_task_large_args_and_returns(ray_start_shared):
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    arr = np.ones(300_000, dtype=np.float32)
+    out = ray_tpu.get(double.remote(arr), timeout=60)
+    assert out.shape == arr.shape
+    assert out[0] == 2.0
+
+
+def test_multiple_returns(ray_start_shared):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_shared):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(exceptions.TaskError) as ei:
+        ray_tpu.get(boom.remote(), timeout=60)
+    assert "boom!" in str(ei.value)
+
+
+def test_wait(ray_start_shared):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=30)
+    assert ready and ray_tpu.get(ready[0]) == "fast"
+    assert pending == [s] or not pending
+
+
+def test_actor_basics(ray_start_shared):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def get(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 11
+    assert ray_tpu.get(c.incr.remote(5), timeout=30) == 16
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 16
+
+
+def test_actor_error(ray_start_shared):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor oops")
+
+    b = Bad.remote()
+    with pytest.raises(exceptions.ActorError) as ei:
+        ray_tpu.get(b.fail.remote(), timeout=60)
+    assert "actor oops" in str(ei.value)
+
+
+def test_named_actor(ray_start_shared):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+            return True
+
+        def get(self):
+            return self.v
+
+    s = Store.options(name="kvstore").remote()
+    ray_tpu.get(s.set.remote("hello"), timeout=60)
+    s2 = ray_tpu.get_actor("kvstore")
+    assert ray_tpu.get(s2.get.remote(), timeout=30) == "hello"
+
+
+def test_actor_kill(ray_start_shared):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises((exceptions.ActorDiedError, exceptions.ActorError,
+                        exceptions.ActorUnavailableError)):
+        ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_options_validation(ray_start_shared):
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(num_cpus=-1)
+        def f():
+            pass
+
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(bogus_option=1)
+        def g():
+            pass
+
+
+def test_nested_tasks(ray_start_shared):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1), timeout=90) == 12
+
+
+def test_cluster_resources(ray_start_shared):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4
