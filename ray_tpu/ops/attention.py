@@ -1,0 +1,349 @@
+"""Flash attention for TPU (Pallas) with an XLA fallback.
+
+No reference analogue — the reference delegates all kernel work to
+torch/CUDA (SURVEY.md §2.6: TP/SP absent, math lives inside train_func).
+For a TPU-native framework the fused attention kernel is a core op: it keeps
+the S×S score matrix out of HBM (block-online softmax in VMEM), which is what
+makes long-context training possible at all.
+
+Algorithm: standard flash attention v2 tiling.
+  forward: for each q block, stream kv blocks; online softmax keeps running
+  max m and normalizer l; out = acc / l; LSE saved for backward.
+  backward: two kernels — dkv (grid over kv blocks, loop q) and dq (grid over
+  q blocks, loop kv) — recompute p from saved LSE.
+
+Shapes: [batch, heads, seq, head_dim]; block sizes default 128 (MXU tile).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) implementation — correctness baseline + CPU path
+
+
+def attention_reference(q, k, v, *, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        segment_ids=None):
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    if causal:
+        qi = jnp.arange(seq_q)[:, None] + (seq_k - seq_q)
+        ki = jnp.arange(seq_k)[None, :]
+        logits = jnp.where(ki <= qi, logits, _NEG_INF)
+    if segment_ids is not None:
+        q_seg, k_seg = segment_ids
+        mask = q_seg[:, None, :, None] == k_seg[:, None, None, :]
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_k, seq_k):
+    # refs: q [bq, d]; k/v [seq_k, d]; o [bq, d]; lse [bq]
+    from jax.experimental import pallas as pl
+
+    bq, d = q_ref.shape
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    qi = pl.program_id(1)
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    num_kv = seq_k // block_k
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        num_kv_needed = (qi + 1) * bq // block_k
+        num_kv_needed = jnp.minimum(
+            pl.cdiv((qi + 1) * bq, block_k), num_kv)
+    else:
+        num_kv_needed = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv_needed, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                   interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    assert sq % bq == 0 and sk % bk == 0, (
+        f"seq lengths must be multiples of block sizes ({sq}%{bq}, {sk}%{bk})"
+        " — pad to tile boundaries (fixed shapes keep XLA from recompiling)")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_k=bk, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q):
+    from jax.experimental import pallas as pl
+
+    bk, d = k_ref.shape
+    kj = pl.program_id(1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    num_q = seq_q // block_q
+    if causal:
+        start_q = (kj * bk) // block_q
+    else:
+        start_q = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q)]
+        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = kj * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, causal, block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    bq, d = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    num_kv = seq_k // block_k
+    if causal:
+        num_kv_needed = jnp.minimum(
+            pl.cdiv((qi + 1) * bq, block_k), num_kv)
+    else:
+        num_kv_needed = num_kv
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv_needed, body, dq)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
+                    interpret=False):
+    from jax.experimental import pallas as pl
+
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)  # [b,h,sq]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    dof = g.reshape(b * h, sq, d)
+    lsef = lse.reshape(b * h, sq)
+    deltaf = delta.reshape(b * h, sq)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, block_q=bq, seq_q=sq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, block_k=bk, seq_k=sk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_backward(res, g, sm_scale=sm_scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    force_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Fused attention. [b, h, s, d] → [b, h, s, d].
+
+    On TPU runs the Pallas kernel; elsewhere falls back to the XLA reference
+    (still fused reasonably by XLA on CPU for tests)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    use = _use_pallas() if force_pallas is None else force_pallas
+    if not use and not interpret:
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_attention(q, k, v, sm_scale, causal, block_q, block_k,
+                            interpret)

@@ -1,0 +1,124 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Absent from the reference (SURVEY.md §5.7: no ring attention / sequence
+parallelism anywhere in train/ or util/) — this is a required TPU-native
+capability: long sequences are sharded over the ``sp`` axis, each device
+holds S/sp query and kv shards, and kv shards rotate around the ICI ring via
+``ppermute`` while each device accumulates attention with an online softmax
+(m, l running statistics) — compute on the current kv shard overlaps the
+transfer of the next (XLA overlaps the collective-permute with the einsum).
+
+Memory: O(S/sp · d) per device instead of O(S²) — sequence length scales
+linearly with the number of devices in the ring.
+
+Usage: inside shard_map with sequences sharded over axis ``sp``:
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, sm_scale, mask):
+    """One q-shard × kv-shard attention block, returning unnormalized
+    (acc, m, l) statistics for online-softmax merging."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    # guard fully-masked rows (all -inf): exp underflows to 0, fine
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def _merge(acc, m, l, acc2, m2, l2):
+    m_new = jnp.maximum(m, m2)
+    a1 = jnp.exp(m - m_new)
+    a2 = jnp.exp(m2 - m_new)
+    acc_new = acc * a1[..., None] + acc2 * a2[..., None]
+    l_new = l * a1 + l2 * a2
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp",
+                   causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Attention over sequences sharded on ``axis_name``.
+
+    Must be called inside shard_map/pjit with q/k/v sequence dims sharded
+    over the ring axis. Shapes per device: [b, h, s_local, d].
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    b, h, _, d = q.shape
+
+    acc = jnp.zeros(q.shape[:3] + (d,), jnp.float32)
+    m = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def make_mask(kv_idx):
+        if not causal:
+            return None
+        q_pos = my_idx * s_local + jnp.arange(s_local)[:, None]
+        k_pos = kv_idx * s_local + jnp.arange(s_local)[None, :]
+        return (k_pos <= q_pos)[None, None]  # [1,1,q,k]
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # which shard do we currently hold? it started at (my_idx) and has
+        # been rotated i times: shard index = (my_idx - i) mod size
+        kv_idx = (my_idx - i) % axis_size
+        if causal:
+            # skip blocks entirely in the future (kv_idx > my_idx)
+            mask = make_mask(kv_idx)
+            acc2, m2, l2 = _block_attend(q, k_cur, v_cur, sm_scale, mask)
+            skip = kv_idx > my_idx
+            acc2 = jnp.where(skip, 0.0, acc2)
+            m2 = jnp.where(skip, _NEG_INF, m2)
+            l2 = jnp.where(skip, 0.0, l2)
+        else:
+            acc2, m2, l2 = _block_attend(q, k_cur, v_cur, sm_scale, None)
+        acc, m, l = _merge(acc, m, l, acc2, m2, l2)
+        # rotate kv to the next device; overlaps with the next iteration's
+        # compute under XLA's async collective-permute
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_next, v_next
+
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (acc, m, l, k, v))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
+                           causal: bool = False,
+                           sm_scale: Optional[float] = None):
+    """Convenience wrapper: runs ring_attention under shard_map on `mesh`
+    with [b, h, s, d] inputs sharded over the sequence dim."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+
+    def inner(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              sm_scale=sm_scale)
+
+    return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -8,12 +8,23 @@ exercised without TPU hardware (SURVEY.md environment notes).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
+# Must be set before jax initializes a backend anywhere in the test process.
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RTPU_PRESTART_WORKERS", "0")
+
+# The axon sitecustomize imports jax before this conftest runs, so the env
+# var alone is too late — force the platform through the live config (safe
+# as long as no backend has been initialized yet).
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # tests compare kernel numerics against XLA references: keep f32 matmuls
+    jax.config.update("jax_default_matmul_precision", "highest")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 
