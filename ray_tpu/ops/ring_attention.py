@@ -121,4 +121,4 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
                               sm_scale=sm_scale)
 
     return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)
