@@ -257,8 +257,12 @@ class Raylet:
                 str(c) for c in tpu_chips)
         else:
             # CPU-only workers must not initialize the TPU plugin: grabbing
-            # libtpu would lock the chips away from TPU workers.
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # libtpu would lock the chips away from TPU workers. Force the
+            # override — the inherited env may pin a TPU platform (and the
+            # axon tunnel's sitecustomize re-registers its plugin whenever
+            # PALLAS_AXON_POOL_IPS is present, so clear that too).
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[k] = v
         cwd = runtime_env.get("working_dir") or None

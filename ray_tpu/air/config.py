@@ -1,0 +1,72 @@
+"""AIR configs.
+
+Reference analogue: python/ray/air/config.py — ScalingConfig:79,
+FailureConfig:454, CheckpointConfig:513, RunConfig:642. ScalingConfig gains
+TPU-first fields: chips per worker, slice topology, and the MeshSpec axes for
+model parallelism inside the SPMD island.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False
+    # resources per gang worker
+    resources_per_worker: Optional[Dict[str, float]] = None
+    tpu_chips_per_worker: int = 0  # 0 = all chips of the worker's host
+    # constrain workers onto hosts of one slice (ICI gang domain)
+    tpu_topology: Optional[str] = None
+    placement_strategy: str = "PACK"
+    # model-parallel axes inside the island (dp fills the remainder)
+    mesh: Optional[Dict[str, int]] = None
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.tpu_chips_per_worker or 1)
+        if self.use_gpu and "GPU" not in res:
+            res["GPU"] = 1.0
+        return res
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        per = self.worker_resources()
+        for k, v in per.items():
+            out[k] = v * self.num_workers
+        return out
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = infinite; trial restarts from last ckpt
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False

@@ -1,0 +1,122 @@
+"""Train/Tune session: the worker-side reporting API.
+
+Reference analogue: python/ray/air/session.py — report:41, get_checkpoint:94,
+get_dataset_shard:345, world_rank/local_rank accessors. A session is
+installed thread-locally in each train worker (and in function trainables);
+``report`` enqueues a TrainingResult consumed by the BackendExecutor/Tune.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TrainingResult:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any] = None
+
+
+@dataclass
+class _Session:
+    world_rank: int = 0
+    local_rank: int = 0
+    node_rank: int = 0
+    world_size: int = 1
+    trial_name: str = ""
+    trial_id: str = ""
+    experiment_name: str = ""
+    checkpoint: Optional[Any] = None
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    result_queue: "queue.Queue[TrainingResult]" = field(
+        default_factory=queue.Queue)
+    stop_event: threading.Event = field(default_factory=threading.Event)
+    tpu_chips: tuple = ()
+    mesh: Any = None  # the SPMD island's jax Mesh, set by the backend
+
+
+_tls = threading.local()
+
+
+def _set_session(s: Optional[_Session]):
+    _tls.session = s
+
+
+def _get_session(warn: bool = True) -> Optional[_Session]:
+    s = getattr(_tls, "session", None)
+    return s
+
+
+def in_session() -> bool:
+    return _get_session() is not None
+
+
+def report(metrics: Dict[str, Any], *, checkpoint=None):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("session.report() called outside a train session")
+    s.result_queue.put(TrainingResult(dict(metrics), checkpoint))
+    if s.stop_event.is_set():
+        raise StopIteration("session stopped")
+
+
+def get_checkpoint():
+    s = _get_session()
+    return s.checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _get_session()
+    if s is None:
+        return None
+    return s.dataset_shards.get(name)
+
+
+def get_world_rank() -> int:
+    s = _get_session()
+    return s.world_rank if s else 0
+
+
+def get_local_rank() -> int:
+    s = _get_session()
+    return s.local_rank if s else 0
+
+
+def get_node_rank() -> int:
+    s = _get_session()
+    return s.node_rank if s else 0
+
+
+def get_world_size() -> int:
+    s = _get_session()
+    return s.world_size if s else 1
+
+
+def get_trial_name() -> str:
+    s = _get_session()
+    return s.trial_name if s else ""
+
+
+def get_trial_id() -> str:
+    s = _get_session()
+    return s.trial_id if s else ""
+
+
+def get_experiment_name() -> str:
+    s = _get_session()
+    return s.experiment_name if s else ""
+
+
+def get_mesh():
+    """The SPMD island's jax.sharding.Mesh (TPU-first addition: set up by the
+    Jax backend so train_funcs never build meshes by hand)."""
+    s = _get_session()
+    return s.mesh if s else None
+
+
+def get_tpu_chips() -> tuple:
+    s = _get_session()
+    return s.tpu_chips if s else ()

@@ -1,0 +1,13 @@
+"""ray_tpu.train — distributed training orchestration (reference: ray.train)."""
+
+from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    BaseTrainer, DataParallelTrainer, JaxConfig, Result)
+from ray_tpu.train._internal.backend_executor import (  # noqa: F401
+    BackendExecutor, TrainingFailedError)
+from ray_tpu.air import session  # noqa: F401
+from ray_tpu.air.session import (  # noqa: F401
+    report, get_checkpoint, get_dataset_shard, get_world_rank,
+    get_local_rank, get_node_rank, get_world_size, get_mesh)
+from ray_tpu.air.checkpoint import Checkpoint, ShardedCheckpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)

@@ -1,0 +1,200 @@
+"""Gang worker group for Train.
+
+Reference analogue: train/_internal/worker_group.py:92 — a set of actors
+forming one training gang, placed in a placement group so the whole gang
+schedules atomically (on TPU: a gang == an SPMD island; one worker per host
+of the slice; slice atomicity per SURVEY.md §7 'Gang semantics')."""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One gang member. Runs the user's train_func in a thread and streams
+    session results (reference: backend_executor start_training / session)."""
+
+    def __init__(self):
+        self._session = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._finished = False
+        self._return_value = None
+
+    def get_metadata(self) -> Dict[str, Any]:
+        import os
+        ctx = ray_tpu.get_runtime_context()
+        return {
+            "node_id": ctx.get_node_id(),
+            "pid": os.getpid(),
+            "hostname": os.uname().nodename,
+            "tpu_chips": ray_tpu.get_tpu_ids(),
+        }
+
+    def setup_session(self, *, world_rank: int, local_rank: int,
+                      node_rank: int, world_size: int,
+                      trial_name: str = "", trial_id: str = "",
+                      experiment_name: str = "",
+                      checkpoint=None) -> bool:
+        self._session = air_session._Session(
+            world_rank=world_rank, local_rank=local_rank,
+            node_rank=node_rank, world_size=world_size,
+            trial_name=trial_name, trial_id=trial_id,
+            experiment_name=experiment_name, checkpoint=checkpoint,
+            tpu_chips=tuple(ray_tpu.get_tpu_ids()))
+        return True
+
+    def set_dataset_shard(self, name: str, shard) -> bool:
+        self._session.dataset_shards[name] = shard
+        return True
+
+    def setup_jax_distributed(self, coordinator: str, num_processes: int,
+                              process_id: int) -> bool:
+        """Join the SPMD island (replaces torch dist.init_process_group,
+        reference train/torch/config.py:69)."""
+        if num_processes <= 1:
+            return True
+        import jax
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return True
+
+    def get_free_port(self) -> int:
+        import socket
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def get_ip(self) -> str:
+        import socket
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+            s.close()
+            return ip
+        except Exception:
+            return "127.0.0.1"
+
+    def start_training(self, train_func: Callable, config: Dict[str, Any]
+                       ) -> bool:
+        air_session._set_session(self._session)
+
+        def run():
+            air_session._set_session(self._session)
+            try:
+                import inspect
+                sig = inspect.signature(train_func)
+                if len(sig.parameters) >= 1:
+                    self._return_value = train_func(config)
+                else:
+                    self._return_value = train_func()
+            except StopIteration:
+                pass
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                self._finished = True
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="train_func")
+        self._thread.start()
+        return True
+
+    def get_next_result(self, timeout: float = 5.0):
+        """Long-poll one TrainingResult; returns dict or status marker."""
+        import queue as _q
+        if self._session is None:
+            return {"status": "no_session"}
+        try:
+            r = self._session.result_queue.get(timeout=timeout)
+            return {"status": "result", "metrics": r.metrics,
+                    "checkpoint": r.checkpoint}
+        except _q.Empty:
+            if self._error is not None:
+                return {"status": "error", "error": self._error}
+            if self._finished:
+                return {"status": "finished",
+                        "return_value": self._return_value}
+            return {"status": "pending"}
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def get_error(self) -> Optional[str]:
+        return self._error
+
+    def shutdown_jax(self) -> bool:
+        try:
+            import jax
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK",
+                 tpu_topology: Optional[str] = None):
+        self.num_workers = num_workers
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        if not self.pg.ready(timeout=120):
+            remove_placement_group(self.pg)
+            raise RuntimeError(
+                f"placement group for {num_workers} x "
+                f"{resources_per_worker} did not become ready")
+        opts: Dict[str, Any] = {}
+        num_tpus = resources_per_worker.get("TPU", 0)
+        num_cpus = resources_per_worker.get("CPU", 1)
+        self.workers = []
+        for i in range(num_workers):
+            w = TrainWorker.options(
+                num_cpus=num_cpus, num_tpus=num_tpus,
+                tpu_topology=tpu_topology,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    self.pg, placement_group_bundle_index=i),
+            ).remote()
+            self.workers.append(w)
+        # stable rank ordering: sort by (node, pid) like the reference's
+        # rank mapping (backend_executor.py:380)
+        metas = ray_tpu.get([w.get_metadata.remote() for w in self.workers],
+                            timeout=180)
+        order = sorted(range(num_workers),
+                       key=lambda i: (metas[i]["node_id"], metas[i]["pid"]))
+        self.workers = [self.workers[i] for i in order]
+        self.metadata = [metas[i] for i in order]
+
+    def execute(self, method_name: str, *args, timeout=180, **kwargs):
+        refs = [getattr(w, method_name).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def execute_single(self, rank: int, method_name: str, *args,
+                       timeout=180, **kwargs):
+        ref = getattr(self.workers[rank], method_name).remote(*args, **kwargs)
+        return ray_tpu.get(ref, timeout=timeout)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass

@@ -1,0 +1,181 @@
+"""DataParallelTrainer + BaseTrainer.
+
+Reference analogue: train/base_trainer.py:339 (fit wraps into a Tune
+trainable) and train/data_parallel_trainer.py:56/329 (training_loop drives
+BackendExecutor). The framework backend is JAX: gang workers form an SPMD
+island via jax.distributed; inside the island the train_func sees the full
+mesh and uses pjit/psum — no NCCL, no DDP wrappers.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.train._internal.backend_executor import (BackendExecutor,
+                                                      TrainingFailedError)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Result:
+    """Reference analogue: ray.air.Result."""
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Any] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def best_checkpoints(self):
+        return [(self.checkpoint, self.metrics)] if self.checkpoint else []
+
+
+class JaxConfig:
+    """Backend config (reference analogue: TorchConfig/TensorflowConfig).
+    Exists for API parity; island formation itself lives in the executor."""
+
+    def __init__(self, coordinator_port: int = 0):
+        self.coordinator_port = coordinator_port
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap into a Tune Trainable (reference: base_trainer.py:365)."""
+        from ray_tpu.tune.trainable import FunctionTrainable
+        trainer = self
+
+        def _train_fn(config):
+            from ray_tpu.air import session
+            t = trainer._with_config_overrides(config)
+            result = t._fit_internal(report_through_session=True)
+            if result.error:
+                raise TrainingFailedError(result.error)
+
+        return _train_fn
+
+    def _with_config_overrides(self, config: Dict[str, Any]):
+        return self
+
+
+class DataParallelTrainer(BaseTrainer):
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or JaxConfig()
+
+    def _with_config_overrides(self, config: Dict[str, Any]):
+        merged = {**self.train_loop_config, **(config or {})}
+        return DataParallelTrainer(
+            self.train_loop_per_worker, train_loop_config=merged,
+            backend_config=self.backend_config,
+            scaling_config=self.scaling_config, run_config=self.run_config,
+            datasets=self.datasets,
+            resume_from_checkpoint=self.resume_from_checkpoint)
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(self) -> Result:
+        return self._fit_internal(report_through_session=False)
+
+    def _fit_internal(self, report_through_session: bool) -> Result:
+        failure_cfg = self.run_config.failure_config
+        attempts_left = max(failure_cfg.max_failures, 0)
+        infinite = failure_cfg.max_failures == -1
+        checkpoint = self.resume_from_checkpoint
+        while True:
+            try:
+                return self._run_once(checkpoint, report_through_session)
+            except TrainingFailedError as e:
+                logger.warning("training attempt failed: %s", e)
+                if not infinite and attempts_left <= 0:
+                    return Result(error=str(e), checkpoint=checkpoint)
+                attempts_left -= 1
+                checkpoint = self._latest_checkpoint or checkpoint
+                logger.warning(
+                    "restarting gang from last checkpoint (%s retries left)",
+                    "inf" if infinite else attempts_left)
+
+    def _run_once(self, checkpoint, report_through_session: bool) -> Result:
+        from ray_tpu.air import session as air_session
+        executor = BackendExecutor(self.scaling_config, self.backend_config)
+        self._latest_checkpoint = checkpoint
+        trial_id = uuid.uuid4().hex[:8]
+        try:
+            executor.start()
+            dataset_shards = self._shard_datasets(
+                self.scaling_config.num_workers)
+            executor.start_training(
+                self.train_loop_per_worker, self.train_loop_config,
+                checkpoint=checkpoint, dataset_shards=dataset_shards,
+                trial_info={"trial_id": trial_id,
+                            "trial_name": self.run_config.name or
+                            f"train-{trial_id}"})
+            history: List[Dict[str, Any]] = []
+            last_metrics: Dict[str, Any] = {}
+            while True:
+                round_results = executor.get_next_results()
+                if round_results is None:
+                    break
+                rank0 = round_results[0]
+                last_metrics = rank0.metrics
+                history.append(rank0.metrics)
+                ckpt = next((r.checkpoint for r in round_results
+                             if r.checkpoint is not None), None)
+                if ckpt is not None:
+                    self._latest_checkpoint = ckpt
+                if report_through_session and air_session.in_session():
+                    air_session.report(rank0.metrics,
+                                       checkpoint=self._latest_checkpoint)
+                if self._should_stop(last_metrics):
+                    break
+            return Result(metrics=last_metrics,
+                          checkpoint=self._latest_checkpoint,
+                          metrics_history=history)
+        finally:
+            executor.shutdown()
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        stop = self.run_config.stop
+        if not stop:
+            return False
+        for k, v in stop.items():
+            if k in metrics and metrics[k] >= v:
+                return True
+        return False
+
+    def _shard_datasets(self, num_workers: int) -> Dict[str, Any]:
+        """Split each dataset into per-worker shards (reference:
+        RayDatasetSpec.get_dataset_shards)."""
+        out: Dict[str, Any] = {}
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                out[name] = ds.split(num_workers)
+            else:
+                out[name] = ds
+        return out
