@@ -73,6 +73,9 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16  # compute dtype; params stay f32
     act: Callable = nn.relu
     small_images: bool = False  # CIFAR stem: 3x3/1 conv, no maxpool
+    bn_axis_name: Optional[str] = None  # set under shard_map/pmap for
+    # cross-replica sync-BN; None under jit/GSPMD (local-shard stats, the
+    # standard large-batch approximation — torch DDP BatchNorm does the same)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -80,7 +83,8 @@ class ResNet(nn.Module):
                                  padding="SAME")
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=self.dtype, axis_name="batch")
+                                 dtype=self.dtype,
+                                 axis_name=self.bn_axis_name)
         x = x.astype(self.dtype)
         if self.small_images:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)

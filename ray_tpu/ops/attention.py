@@ -65,7 +65,8 @@ def attention_reference(q, k, v, *, causal: bool = False,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 block_k, seq_k):
-    # refs: q [bq, d]; k/v [seq_k, d]; o [bq, d]; lse [bq]
+    # refs: q [bq, d]; k/v [seq_k, d]; o [bq, d]; lse [bq, 1]
+    # (lse keeps a trailing lane dim — TPU blocks must be >=2D tiles)
     from jax.experimental import pallas as pl
 
     bq, d = q_ref.shape
@@ -79,7 +80,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     num_kv = seq_k // block_k
     if causal:
         # kv blocks strictly above the diagonal contribute nothing
-        num_kv_needed = (qi + 1) * bq // block_k
         num_kv_needed = jnp.minimum(
             pl.cdiv((qi + 1) * bq, block_k), num_kv)
     else:
@@ -109,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(0, num_kv_needed, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l))[:, 0]
+    lse_ref[:] = m + jnp.log(l)
 
 
 def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
@@ -138,11 +138,11 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -174,8 +174,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q)]
-        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]      # [bq, 1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]  # [bq, 1]
         s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -184,12 +184,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = kj * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -207,8 +207,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[:]      # [bq, 1]
+    delta = delta_ref[:]  # [bq, 1]
     dq = jnp.zeros((bq, d), jnp.float32)
 
     num_kv = seq_k // block_k
@@ -229,10 +229,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -255,8 +255,8 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
     dof = g.reshape(b * h, sq, d)
-    lsef = lse.reshape(b * h, sq)
-    deltaf = delta.reshape(b * h, sq)
+    lsef = lse.reshape(b * h, sq, 1)
+    deltaf = delta.reshape(b * h, sq, 1)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=bq, seq_q=sq)
@@ -268,8 +268,8 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sq, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
@@ -292,8 +292,8 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
-            pl.BlockSpec((None, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -343,6 +343,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     use = _use_pallas() if force_pallas is None else force_pallas
+    # Auto mode falls back to XLA for shapes the kernel can't tile: seq not
+    # divisible by the (clamped) block sizes, or blocks under the TPU
+    # sublane minimum (16 covers bf16's (16,128) tile). An explicit
+    # force_pallas=True is honored — the kernel's own asserts surface.
+    sq, sk = q.shape[2], k.shape[2]
+    if force_pallas is None and use:
+        bq, bk = min(block_q, sq), min(block_k, sk)
+        if (sq % bq or sk % bk or bq % 16 or bk % 16):
+            use = False
     if not use and not interpret:
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash_attention(q, k, v, sm_scale, causal, block_q, block_k,

@@ -1,0 +1,248 @@
+"""SPMD training-step builders: full jitted train step over a device mesh.
+
+This is the TPU-native heart of the Train layer. The reference wraps the
+user's torch model in DDP/FSDP inside each worker (reference:
+train/torch/train_loop_utils.py:51 prepare_model, :91 FSDP) and lets NCCL
+sync gradients. Here there is no wrapper: the *whole* train step — forward,
+backward, optimizer update — is one XLA program jitted over a
+`jax.sharding.Mesh`, with parameter/optimizer/data shardings derived from a
+`MeshSpec` (dp/fsdp/tp/sp/...). XLA inserts the psum/all-gather/
+reduce-scatter collectives over ICI; there is nothing like a process group
+to manage.
+
+Design notes (TPU-first):
+  - state is a plain dict pytree {params, opt, step}: optax state mirrors
+    the param tree, so one path-based sharding rule covers both.
+  - `donate_argnums=(0,)` donates the state buffers — the update is
+    in-place in HBM, no 2x parameter memory.
+  - batch sharding: batch dim over (dp, fsdp), sequence dim over sp (ring
+    attention consumes the seq shards).
+  - loss/metrics come back replicated (XLA psums them across dp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import (MeshSpec, param_sharding)
+
+
+def state_shardings(abstract_state, mesh, spec: MeshSpec):
+    """Sharding pytree for an arbitrary train-state pytree.
+
+    Optax states (mu/nu of adam) mirror the param tree, so the trailing path
+    keys hit the same `param_sharding` rules as the params themselves;
+    scalars (step counts, schedules) replicate.
+    """
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    leaves, treedef = tree_flatten_with_path(abstract_state)
+    out = []
+    for path, leaf in leaves:
+        keys = tuple(getattr(p, "key", getattr(p, "idx", str(p)))
+                     for p in path)
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0:
+            out.append(NamedSharding(mesh, P()))
+        else:
+            out.append(param_sharding(mesh, keys, shape, spec))
+    return tree_unflatten(treedef, out)
+
+
+@dataclasses.dataclass
+class SpmdTrainer:
+    """A compiled SPMD training program bound to a mesh.
+
+    init(rng) -> state                (sharded across the mesh)
+    step(state, batch) -> state, metrics
+    """
+    mesh: Any
+    spec: MeshSpec
+    init: Callable
+    step: Callable
+    batch_shardings: Any
+    state_sharding_tree: Any
+
+
+def make_causal_lm_trainer(
+    model_config=None,
+    *,
+    mesh=None,
+    spec: Optional[MeshSpec] = None,
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    grad_clip: float = 1.0,
+    model=None,
+) -> SpmdTrainer:
+    """GPT-style causal-LM SPMD trainer (the flagship train step).
+
+    Reference analogue (capability, not design): the HF GPT-2 fine-tune
+    config (train/huggingface/huggingface_trainer.py:157) — there, torch
+    Trainer + DDP inside Ray workers; here, one pjit'd program over the mesh.
+    """
+    from ray_tpu.models.gpt2 import GPT2, GPT2Config, causal_lm_loss
+
+    if spec is None:
+        spec = MeshSpec()
+    if mesh is None:
+        mesh = spec.build()
+    if model is None:
+        model = GPT2(model_config or GPT2Config.small())
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate, b1=0.9, b2=0.95,
+                    weight_decay=weight_decay),
+    )
+
+    seq_probe = 8  # init only traces shapes; seq length is free at step time
+
+    def init_fn(rng):
+        params = model.init(rng, jnp.zeros((1, seq_probe), jnp.int32))[
+            "params"]
+        return {"params": params, "opt": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    st_sh = state_shardings(abstract, mesh, spec)
+
+    init = jax.jit(init_fn, out_shardings=st_sh)
+
+    batch_sh = {
+        "input_ids": NamedSharding(mesh, P(("dp", "fsdp"), "sp")),
+        "labels": NamedSharding(mesh, P(("dp", "fsdp"), "sp")),
+    }
+    repl = NamedSharding(mesh, P())
+
+    dropout = float(getattr(model.config, "dropout", 0.0) or 0.0)
+    base_rng = jax.random.PRNGKey(17)
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            if dropout > 0.0:
+                logits = model.apply(
+                    {"params": p}, batch["input_ids"], deterministic=False,
+                    rngs={"dropout": jax.random.fold_in(
+                        base_rng, state["step"])})
+            else:
+                logits = model.apply({"params": p}, batch["input_ids"],
+                                     deterministic=True)
+            return causal_lm_loss(logits, batch["labels"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        new_state = {"params": params, "opt": opt,
+                     "step": state["step"] + 1}
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(st_sh, batch_sh),
+        out_shardings=(st_sh, {"loss": repl, "grad_norm": repl}),
+        donate_argnums=(0,),
+    )
+    return SpmdTrainer(mesh=mesh, spec=spec, init=init, step=step,
+                       batch_shardings=batch_sh, state_sharding_tree=st_sh)
+
+
+def make_image_classifier_trainer(
+    model,
+    *,
+    mesh=None,
+    spec: Optional[MeshSpec] = None,
+    learning_rate: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+    input_shape: Tuple[int, ...] = (1, 224, 224, 3),
+) -> SpmdTrainer:
+    """ResNet-style SPMD trainer with batch-norm state.
+
+    Reference analogue: resnet50_ray_air.py (MLPerf-style ResNet-50 DDP
+    benchmark). State carries flax `batch_stats`; cross-dp batchnorm uses
+    the local shard statistics (the standard large-batch approximation —
+    the reference's torch DDP BatchNorm does the same).
+    """
+    if spec is None:
+        spec = MeshSpec()
+    if mesh is None:
+        mesh = spec.build()
+
+    tx = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(learning_rate, momentum=momentum, nesterov=True),
+    )
+
+    def init_fn(rng):
+        variables = model.init(rng, jnp.zeros(input_shape, jnp.float32),
+                               train=False)
+        params = variables["params"]
+        return {"params": params,
+                "batch_stats": variables.get("batch_stats", {}),
+                "opt": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    st_sh = state_shardings(abstract, mesh, spec)
+    init = jax.jit(init_fn, out_shardings=st_sh)
+
+    batch_sh = {
+        "image": NamedSharding(mesh, P(("dp", "fsdp"))),
+        "label": NamedSharding(mesh, P(("dp", "fsdp"))),
+    }
+    repl = NamedSharding(mesh, P())
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            out, mut = model.apply(
+                {"params": p, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(batch["label"], out.shape[-1])
+            loss = optax.softmax_cross_entropy(out, onehot).mean()
+            return loss, (out, mut["batch_stats"])
+
+        (loss, (logits, new_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        new_state = {"params": params, "batch_stats": new_bs,
+                     "opt": opt, "step": state["step"] + 1}
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(st_sh, batch_sh),
+        out_shardings=(st_sh, {"loss": repl, "accuracy": repl}),
+        donate_argnums=(0,),
+    )
+    return SpmdTrainer(mesh=mesh, spec=spec, init=init, step=step,
+                       batch_shardings=batch_sh, state_sharding_tree=st_sh)
+
+
+def put_batch(trainer: SpmdTrainer, batch: Dict[str, np.ndarray]):
+    """Host batch -> sharded device arrays matching the trainer layout."""
+    return {k: jax.device_put(v, trainer.batch_shardings[k])
+            for k, v in batch.items()}
+
+
+def default_spec_for(n_devices: int) -> MeshSpec:
+    """A sensible multi-axis MeshSpec exercising dp/tp/sp for N devices.
+
+    Used by the multichip dryrun: factorize N into (dp, sp, tp) with tp/sp
+    innermost (ICI-adjacent), dp taking the remainder.
+    """
+    tp = 2 if n_devices % 2 == 0 else 1
+    rem = n_devices // tp
+    sp = 2 if rem % 2 == 0 and rem >= 4 else 1
+    dp = rem // sp
+    return MeshSpec(dp=dp, sp=sp, tp=tp)

@@ -1,0 +1,69 @@
+"""SPMD train-step + driver entry tests (8-device virtual CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+
+def test_causal_lm_trainer_multiaxis(cpu_mesh8):
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train.spmd import make_causal_lm_trainer, put_batch
+
+    spec = MeshSpec(dp=2, sp=2, tp=2)
+    mesh = spec.build(jax.devices("cpu")[:8])
+    import dataclasses
+    cfg = dataclasses.replace(GPT2Config.tiny(), n_embd=128, n_head=4,
+                              attention_backend="reference")
+    tr = make_causal_lm_trainer(cfg, mesh=mesh, spec=spec)
+    state = tr.init(jax.random.PRNGKey(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 32), dtype=np.int32)
+    batch = put_batch(tr, {"input_ids": tokens, "labels": tokens})
+    losses = []
+    for _ in range(3):
+        state, m = tr.step(state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"loss did not fall: {losses}"
+    # params actually sharded: at least one leaf is not fully replicated
+    shardings = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding, state["params"]))
+    assert any(not s.is_fully_replicated for s in shardings)
+
+
+def test_image_trainer_dp(cpu_mesh8):
+    from ray_tpu.models.resnet import create_resnet
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train.spmd import make_image_classifier_trainer, put_batch
+
+    spec = MeshSpec(dp=8)
+    mesh = spec.build(jax.devices("cpu")[:8])
+    import jax.numpy as jnp
+    model = create_resnet("resnet18", num_classes=10, small_images=True,
+                          dtype=jnp.float32)
+    tr = make_image_classifier_trainer(model, mesh=mesh, spec=spec,
+                                       input_shape=(1, 32, 32, 3))
+    state = tr.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = put_batch(tr, {
+        "image": rng.standard_normal((16, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, (16,), dtype=np.int32)})
+    state, m = tr.step(state, batch)
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_graft_entry_shapes():
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (4, 512, 50257)
+
+
+def test_graft_dryrun_8():
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
