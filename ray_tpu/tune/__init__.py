@@ -1,0 +1,23 @@
+"""ray_tpu.tune — hyperparameter search / experiment engine.
+
+Reference: python/ray/tune — Tuner (tuner.py:44), tune.run (tune/tune.py:131),
+Trainable (trainable/trainable.py:66), searchers (search/), schedulers
+(schedulers/). Train and RLlib ride on this layer, as in the reference.
+"""
+
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice, grid_search, loguniform, quniform, randint, randn, sample_from,
+    uniform)
+from ray_tpu.tune.trainable import Trainable  # noqa: F401
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator, ConcurrencyLimiter, RandomSearch, Searcher)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
+    MedianStoppingRule, PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.tune import ExperimentAnalysis, TrialRunner, run  # noqa: F401
+from ray_tpu.tune.tuner import (  # noqa: F401
+    Result, ResultGrid, TuneConfig, Tuner)
+
+# session.report works inside function trainables too (reference: air.session)
+from ray_tpu.air.session import report  # noqa: F401

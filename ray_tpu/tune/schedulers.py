@@ -1,0 +1,202 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference: tune/schedulers/ — async_hyperband.py (ASHA, the workhorse),
+median_stopping_rule.py, pbt.py, hyperband.py. Decisions are made per
+result: CONTINUE / STOP / and for PBT an exploit-mutate step.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+TRAINING_ITERATION = "training_iteration"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: Optional[str], mode: str):
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (default)."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: schedulers/async_hyperband.py).
+
+    At each rung (iteration = grace_period * reduction_factor^k) a trial
+    must beat the rung's top 1/reduction_factor cutoff of previously
+    recorded results or it is stopped. Asynchronous: no waiting for a full
+    bracket — decisions use whatever has been recorded so far.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = TRAINING_ITERATION,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        # rung iteration -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = {}
+        r = grace_period
+        while r < max_t:
+            self._rungs[int(r)] = []
+            r *= reduction_factor
+
+    def _val(self, result) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr)
+        if t is None or self.metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        rung = self._current_rung(t)
+        if rung is None:
+            return CONTINUE
+        v = self._val(result)
+        if v is None:
+            return CONTINUE
+        recorded = self._rungs[rung]
+        recorded.append(v)
+        k = max(1, int(math.ceil(len(recorded) / self.rf)))
+        cutoff = sorted(recorded, reverse=True)[k - 1]
+        if v < cutoff:
+            return STOP
+        return CONTINUE
+
+    def _current_rung(self, t: int) -> Optional[int]:
+        best = None
+        for r in self._rungs:
+            if t >= r and (best is None or r > best):
+                best = r
+        return best
+
+
+# Alias matching the reference's exported name
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average is below the median of the other
+    trials' running averages at the same point (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = TRAINING_ITERATION,
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        v = float(result[self.metric])
+        if self.mode == "min":
+            v = -v
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(v)
+        if result.get(self.time_attr, 0) < self.grace:
+            return CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._avgs.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = sum(hist) / len(hist)
+        return STOP if mine < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py). Every perturbation_interval,
+    bottom-quantile trials clone the checkpoint + config of a random
+    top-quantile trial, with hyperparameters perturbed (x1.2 / x0.8 or
+    resampled). The runner applies the exploit via trial restart/restore.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = TRAINING_ITERATION,
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._scores: Dict[str, float] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        v = float(result[self.metric])
+        self._scores[trial.trial_id] = v if self.mode == "max" else -v
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        scores = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(scores)
+        k = max(1, int(n * self.quantile))
+        if n < 2 * k:
+            return CONTINUE
+        bottom = {tid for tid, _ in scores[:k]}
+        top = [tid for tid, _ in scores[-k:]]
+        if trial.trial_id not in bottom:
+            return CONTINUE
+        donor_id = self._rng.choice(top)
+        donor = runner.get_trial(donor_id)
+        if donor is None or donor.latest_checkpoint is None:
+            return CONTINUE
+        new_config = self._explore(dict(donor.config))
+        runner.exploit(trial, donor, new_config)
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.sample import Domain
+        for key, mut in self.mutations.items():
+            if key not in config:
+                continue
+            if isinstance(mut, Domain):
+                if self._rng.random() < self.resample_prob:
+                    config[key] = mut.sample(self._rng)
+                else:
+                    config[key] = config[key] * self._rng.choice([0.8, 1.2])
+            elif isinstance(mut, (list, tuple)):
+                config[key] = self._rng.choice(list(mut))
+            elif callable(mut):
+                config[key] = mut()
+        return config

@@ -1,0 +1,110 @@
+"""Searchers: config suggestion strategies.
+
+Reference: tune/search/ — basic_variant.py (grid + random, the default),
+searcher ABC (search/searcher.py), ConcurrencyLimiter (search/search_
+generator.py). The optimization-library searchers (optuna/hyperopt/...) are
+soft-gated the way the reference soft-imports them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.sample import expand_grid, resolve
+
+# Sentinel: searcher not ready to suggest yet (at capacity) — distinct from
+# None, which means the search space is exhausted.
+PENDING = object()
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+
+    def set_search_properties(self, metric, mode, space) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """Next config, or None when exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random repeats (the default
+    searcher; reference search/basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._rng = random.Random(seed)
+        self._variants: List[Dict[str, Any]] = []
+        for _ in range(num_samples):
+            self._variants.extend(expand_grid(space))
+        self._next = 0
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._variants):
+            return None
+        variant = self._variants[self._next]
+        self._next += 1
+        return resolve(variant, self._rng)
+
+
+class RandomSearch(Searcher):
+    """Pure random sampling of a Domain-only space (no grid axes)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._space = space
+        self._remaining = num_samples
+        self._rng = random.Random(seed)
+
+    def suggest(self, trial_id):
+        if self._remaining <= 0:
+            return None
+        self._remaining -= 1
+        return resolve(self._space, self._rng)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference: search/concurrency_limiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return PENDING  # runner retries later
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

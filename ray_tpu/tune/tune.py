@@ -1,0 +1,405 @@
+"""TrialRunner event loop + tune.run().
+
+Reference call stack (SURVEY.md §3.4): Tuner.fit → tune.run
+(tune/tune.py:131) → TrialRunner.step (execution/trial_runner.py:962) with
+one Trainable actor per trial (execution/ray_trial_executor.py:350).
+Here the executor is folded into the runner: trials are ray_tpu actors,
+results stream back as object refs, schedulers/searchers see every result.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Type, Union
+
+import ray_tpu
+from ray_tpu.tune import search as search_mod
+from ray_tpu.tune.sample import Domain, GridSearch
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     TrialScheduler)
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trainable import (DONE, TRAINING_ITERATION, Trainable,
+                                    wrap_function)
+from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial)
+
+
+class _TrialActorShim:
+    """The per-trial actor: hosts the Trainable instance."""
+
+    def create(self, trainable_cls, config, start_iteration: int = 0) -> bool:
+        self._t = trainable_cls(config)
+        # restart continuity: training_iteration keeps counting across
+        # failure-restarts (function trainables don't persist it themselves)
+        if start_iteration:
+            self._t._iteration = start_iteration
+        return True
+
+    def train(self) -> Dict[str, Any]:
+        return self._t.train()
+
+    def save(self):
+        return self._t.save()
+
+    def restore(self, ckpt) -> bool:
+        self._t.restore(ckpt)
+        return True
+
+    def reset(self, config) -> bool:
+        return bool(self._t.reset_config(config))
+
+    def stop(self) -> bool:
+        self._t.stop()
+        return True
+
+
+_TrialActor = ray_tpu.remote(_TrialActorShim)
+
+
+class TrialRunner:
+    def __init__(self, trainable_cls: Type[Trainable],
+                 searcher: Searcher,
+                 scheduler: Optional[TrialScheduler] = None,
+                 *,
+                 experiment_name: str = "exp",
+                 metric: Optional[str] = None, mode: str = "max",
+                 stop: Optional[Dict[str, Any]] = None,
+                 max_concurrent: int = 4,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 checkpoint_freq: int = 0,
+                 num_to_keep: Optional[int] = None,
+                 max_failures: int = 0,
+                 callbacks: Optional[List] = None):
+        self.trainable_cls = trainable_cls
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(metric, mode)
+        self.experiment_name = experiment_name
+        self.metric, self.mode = metric, mode
+        self.stop_criteria = dict(stop or {})
+        self.max_concurrent = max_concurrent
+        self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+        self.checkpoint_freq = checkpoint_freq
+        self.num_to_keep = num_to_keep
+        self.max_failures = max_failures
+        self.callbacks = callbacks or []
+        self.trials: List[Trial] = []
+        self._exhausted = False
+
+    # ------------------------------------------------------------- helpers
+
+    def get_trial(self, trial_id: str) -> Optional[Trial]:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        return None
+
+    def _live_trials(self) -> List[Trial]:
+        return [t for t in self.trials if t.status in (PENDING, RUNNING)]
+
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    # -------------------------------------------------------------- driving
+
+    def _maybe_create_trials(self):
+        while (not self._exhausted and
+               len(self._live_trials()) < self.max_concurrent):
+            tentative = Trial({}, self.experiment_name)
+            config = self.searcher.suggest(tentative.trial_id)
+            if config is search_mod.PENDING:
+                break
+            if config is None:
+                self._exhausted = True
+                break
+            trial = tentative
+            trial.config = config
+            trial.resources = dict(self.resources_per_trial)
+            trial.max_failures = self.max_failures
+            trial.ckpt_manager.num_to_keep = self.num_to_keep
+            trial.ckpt_manager.metric = self.metric
+            trial.ckpt_manager.mode = self.mode
+            self.trials.append(trial)
+
+    def _start_trial(self, trial: Trial, checkpoint=None):
+        opts: Dict[str, Any] = {}
+        custom: Dict[str, float] = {}
+        for k, v in trial.resources.items():
+            if k == "CPU":
+                opts["num_cpus"] = v
+            elif k == "GPU":
+                opts["num_gpus"] = v
+            elif k == "TPU":
+                opts["num_tpus"] = v
+            elif k == "memory":
+                opts["memory"] = v
+            else:
+                custom[k] = v
+        if custom:
+            opts["resources"] = custom
+        trial.actor = _TrialActor.options(**opts).remote()
+        cfg = dict(trial.config)
+        cfg["__trial_id__"] = trial.trial_id
+        cfg["__trial_name__"] = trial.trial_name
+        if checkpoint is not None:
+            cfg["__checkpoint__"] = checkpoint
+        ray_tpu.get(trial.actor.create.remote(
+            self.trainable_cls, cfg, len(trial.results)))
+        if checkpoint is not None:
+            ray_tpu.get(trial.actor.restore.remote(checkpoint))
+        trial.status = RUNNING
+        trial.future = trial.actor.train.remote()
+        for cb in self.callbacks:
+            cb.on_trial_start(trial)
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED):
+        if trial.actor is not None:
+            try:
+                trial.actor.stop.remote()
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.future = None
+        trial.status = status
+        self.searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=(status == ERROR))
+        self.scheduler.on_trial_complete(self, trial, trial.last_result)
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial)
+
+    def _should_stop_trial(self, trial: Trial, result: Dict) -> bool:
+        if result.get(DONE):
+            return True
+        for key, bound in self.stop_criteria.items():
+            if key in result and float(result[key]) >= float(bound):
+                return True
+        return False
+
+    def _save_checkpoint(self, trial: Trial, result: Dict):
+        ckpt = result.pop("__checkpoint__", None)
+        if ckpt is None and self.checkpoint_freq and \
+                result.get(TRAINING_ITERATION, 0) % self.checkpoint_freq == 0:
+            try:
+                ckpt = ray_tpu.get(trial.actor.save.remote())
+            except Exception:
+                ckpt = None
+        if ckpt is not None:
+            trial.ckpt_manager.add(ckpt, result)
+
+    def _process_result(self, trial: Trial, result: Dict[str, Any]):
+        auto_keys = {DONE, TRAINING_ITERATION, "time_total_s",
+                     "__checkpoint__"}
+        if result.get(DONE) and not (set(result) - auto_keys):
+            # terminal sentinel from a finished function trainable — don't
+            # let it clobber last_result's metrics
+            self._stop_trial(trial, TERMINATED)
+            return
+        trial.results.append(result)
+        self.searcher.on_trial_result(trial.trial_id, result)
+        for cb in self.callbacks:
+            cb.on_trial_result(trial, result)
+        self._save_checkpoint(trial, result)
+        if self._should_stop_trial(trial, result):
+            # checkpoint-at-end so stop-criteria trials don't finish bare
+            if self.checkpoint_freq and not result.get(DONE):
+                try:
+                    ckpt = ray_tpu.get(trial.actor.save.remote())
+                    trial.ckpt_manager.add(ckpt, result)
+                except Exception:
+                    pass
+            self._stop_trial(trial, TERMINATED)
+            return
+        fut_before = trial.future
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == STOP:
+            self._stop_trial(trial, TERMINATED)
+        elif trial.future is fut_before:
+            # a PBT exploit may have restarted the actor and queued its
+            # first train() already — don't double-schedule
+            trial.future = trial.actor.train.remote()
+
+    def _process_failure(self, trial: Trial, err: BaseException):
+        trial.error = "".join(traceback.format_exception_only(
+            type(err), err))
+        trial.num_failures += 1
+        if trial.num_failures <= trial.max_failures:
+            # restart from the latest checkpoint (reference:
+            # trial_runner.py:1336 restore-on-failure path)
+            ckpt = trial.latest_checkpoint
+            try:
+                if trial.actor is not None:
+                    ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+            try:
+                self._start_trial(trial, checkpoint=ckpt)
+            except Exception as restart_err:
+                trial.error += f"\nrestart failed: {restart_err!r}"
+                self._stop_trial(trial, ERROR)
+        else:
+            self._stop_trial(trial, ERROR)
+
+    # PBT exploit hook (called by the scheduler)
+    def exploit(self, trial: Trial, donor: Trial,
+                new_config: Dict[str, Any]):
+        ckpt = donor.latest_checkpoint
+        if ckpt is None:
+            return
+        trial.config = new_config
+        in_place = False
+        try:
+            in_place = ray_tpu.get(trial.actor.reset.remote(new_config))
+        except Exception:
+            in_place = False
+        if in_place:
+            ray_tpu.get(trial.actor.restore.remote(ckpt))
+        else:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+            self._start_trial(trial, checkpoint=ckpt)
+        trial.ckpt_manager.add(ckpt, donor.last_result or {})
+
+    # ---------------------------------------------------------------- loop
+
+    def step(self):
+        self._maybe_create_trials()
+        for trial in self.trials:
+            if trial.status == PENDING and trial.actor is None:
+                try:
+                    self._start_trial(trial)
+                except Exception as e:
+                    self._process_failure(trial, e)
+        futures = {t.future: t for t in self._running()
+                   if t.future is not None}
+        if not futures:
+            return
+        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=30.0)
+        for ref in ready:
+            trial = futures[ref]
+            try:
+                result = ray_tpu.get(ref)
+            except Exception as e:
+                self._process_failure(trial, e)
+                continue
+            self._process_result(trial, result)
+
+    def is_finished(self) -> bool:
+        return self._exhausted and not self._live_trials()
+
+    def run_all(self):
+        while not self.is_finished():
+            self.step()
+        return self.trials
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(trainable: Union[Callable, Type[Trainable]],
+        *,
+        config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        stop: Optional[Dict[str, Any]] = None,
+        search_alg: Optional[Searcher] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        max_concurrent_trials: int = 4,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        checkpoint_freq: int = 0,
+        keep_checkpoints_num: Optional[int] = None,
+        max_failures: int = 0,
+        name: str = "exp",
+        callbacks: Optional[List] = None,
+        verbose: int = 0) -> "ExperimentAnalysis":
+    """The reference's tune.run (tune/tune.py:131)."""
+    config = config or {}
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        trainable_cls = trainable
+    elif callable(trainable):
+        trainable_cls = wrap_function(trainable)
+    else:
+        raise TypeError(f"trainable must be a function or Trainable subclass,"
+                        f" got {type(trainable)}")
+
+    if search_alg is None:
+        search_alg = BasicVariantGenerator(config, num_samples=num_samples,
+                                           metric=metric, mode=mode)
+    else:
+        search_alg.set_search_properties(metric, mode, config)
+
+    runner = TrialRunner(
+        trainable_cls, search_alg, scheduler,
+        experiment_name=name, metric=metric, mode=mode, stop=stop,
+        max_concurrent=max_concurrent_trials,
+        resources_per_trial=resources_per_trial,
+        checkpoint_freq=checkpoint_freq,
+        num_to_keep=keep_checkpoints_num,
+        max_failures=max_failures, callbacks=callbacks)
+    trials = runner.run_all()
+    return ExperimentAnalysis(trials, metric=metric, mode=mode)
+
+
+class ExperimentAnalysis:
+    """Result accessor (reference: tune/analysis/experiment_analysis.py)."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str] = None,
+                 mode: str = "max"):
+        self.trials = trials
+        self.default_metric, self.default_mode = metric, mode
+
+    def get_best_trial(self, metric: Optional[str] = None,
+                       mode: Optional[str] = None,
+                       scope: str = "last") -> Optional[Trial]:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        sign = 1.0 if mode == "max" else -1.0
+        best, best_v = None, None
+        for t in self.trials:
+            hist = t.metric_history(metric)
+            if not hist:
+                continue
+            candidates = hist if scope == "all" else hist[-1:]
+            v = max(sign * h for h in candidates)
+            if best_v is None or v > best_v:
+                best, best_v = t, v
+        return best
+
+    @property
+    def best_trial(self) -> Optional[Trial]:
+        return self.get_best_trial()
+
+    @property
+    def best_config(self) -> Optional[Dict[str, Any]]:
+        t = self.best_trial
+        return t.config if t else None
+
+    @property
+    def best_result(self) -> Optional[Dict[str, Any]]:
+        t = self.best_trial
+        return t.last_result if t else None
+
+    @property
+    def best_checkpoint(self):
+        t = self.best_trial
+        return t.best_checkpoint if t else None
+
+    def dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self.trials:
+            row = dict(t.last_result or {})
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    @property
+    def results(self) -> List[Optional[Dict[str, Any]]]:
+        return [t.last_result for t in self.trials]
