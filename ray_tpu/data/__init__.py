@@ -1,0 +1,24 @@
+"""ray_tpu.data — distributed datasets over the object store.
+
+Reference analogue: python/ray/data (Dataset over blocks, read API,
+transforms, shuffle, split, batch iteration). TPU-first: tensor-dict
+blocks, static-shape batch padding, jax.device_put prefetch iterators.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.grouped_data import GroupedData
+from ray_tpu.data.read_api import (Datasource, from_arrow, from_items,
+                                   from_numpy, from_pandas, range,
+                                   range_tensor, read_binary_files, read_csv,
+                                   read_datasource, read_json, read_numpy,
+                                   read_parquet, read_text)
+
+__all__ = [
+    "Dataset", "DatasetPipeline", "GroupedData", "Block", "BlockAccessor",
+    "BlockMetadata", "Datasource", "range", "range_tensor", "from_items",
+    "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
+    "read_json", "read_numpy", "read_text", "read_binary_files",
+    "read_datasource",
+]

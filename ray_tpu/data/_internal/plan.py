@@ -1,0 +1,150 @@
+"""Lazy execution plan with stage fusion.
+
+Reference analogue: python/ray/data/_internal/plan.py (ExecutionPlan:74,
+execute:288) and compute.py (TaskPoolStrategy). A plan is input block refs
+plus a chain of stages; consecutive one-to-one stages fuse into a single
+remote task per block (the reference's stage fusion), all-to-all stages
+(shuffle/sort/repartition) form barriers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class Stage:
+    name: str = "stage"
+
+
+class OneToOneStage(Stage):
+    """block -> block, independently per block; fusable."""
+
+    def __init__(self, name: str, fn: Callable[[Block], Block],
+                 remote_opts: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.fn = fn
+        self.remote_opts = remote_opts or {}
+
+
+class AllToAllStage(Stage):
+    """List[ref] -> List[ref] with a barrier (shuffle/sort/repartition)."""
+
+    def __init__(self, name: str,
+                 fn: Callable[[List[Any]], List[Any]]):
+        self.name = name
+        self.fn = fn  # (block_refs) -> block_refs
+
+
+def _apply_chain(fns, block):
+    for f in fns:
+        block = f(block)
+    return block
+
+
+_chain_task = None
+
+
+def _get_chain_task():
+    """Module-level remote task, created lazily so importing ray_tpu.data
+    does not require an initialized cluster."""
+    global _chain_task
+    if _chain_task is None:
+        import ray_tpu
+        _chain_task = ray_tpu.remote(_apply_chain)
+    return _chain_task
+
+
+class DatasetStats:
+    """Per-stage wall time + block counts (reference: _internal/stats.py)."""
+
+    def __init__(self):
+        self.stages: List[Tuple[str, float, int]] = []
+
+    def record(self, name: str, seconds: float, n_blocks: int):
+        self.stages.append((name, seconds, n_blocks))
+
+    def summary_string(self) -> str:
+        lines = ["Dataset stats:"]
+        for name, secs, n in self.stages:
+            lines.append(f"  stage {name}: {n} blocks, {secs * 1e3:.1f}ms")
+        return "\n".join(lines)
+
+
+class ExecutionPlan:
+    def __init__(self, input_blocks: List[Any],
+                 stages: Optional[List[Stage]] = None,
+                 stats: Optional[DatasetStats] = None):
+        self._in_blocks = list(input_blocks)
+        self._stages: List[Stage] = list(stages or [])
+        self._out_blocks: Optional[List[Any]] = None
+        self._out_meta: Optional[List[Any]] = None
+        self.stats = stats or DatasetStats()
+
+    def with_stage(self, stage: Stage) -> "ExecutionPlan":
+        if self._out_blocks is not None:
+            # already executed: new plan starts from materialized blocks
+            return ExecutionPlan(self._out_blocks, [stage])
+        return ExecutionPlan(self._in_blocks, self._stages + [stage],
+                             stats=self.stats)
+
+    def copy_to(self, blocks: List[Any]) -> "ExecutionPlan":
+        return ExecutionPlan(blocks)
+
+    def is_executed(self) -> bool:
+        return self._out_blocks is not None or not self._stages
+
+    def execute(self) -> List[Any]:
+        """Materialize: returns the output block refs."""
+        if self._out_blocks is not None:
+            return self._out_blocks
+        import ray_tpu
+        blocks = self._in_blocks
+        i = 0
+        while i < len(self._stages):
+            stage = self._stages[i]
+            t0 = time.time()
+            if isinstance(stage, OneToOneStage):
+                # fuse the run of consecutive one-to-one stages
+                fused = [stage]
+                j = i + 1
+                while (j < len(self._stages)
+                       and isinstance(self._stages[j], OneToOneStage)
+                       and self._stages[j].remote_opts == stage.remote_opts):
+                    fused.append(self._stages[j])
+                    j += 1
+                fns = [s.fn for s in fused]
+                name = "+".join(s.name for s in fused)
+                task = _get_chain_task()
+                if stage.remote_opts:
+                    task = task.options(**stage.remote_opts)
+                blocks = [task.remote(fns, b) for b in blocks]
+                self.stats.record(name, time.time() - t0, len(blocks))
+                i = j
+            else:
+                blocks = stage.fn(blocks)
+                self.stats.record(stage.name, time.time() - t0, len(blocks))
+                i += 1
+        # drop references to intermediates; keep outputs pinned
+        self._out_blocks = blocks
+        self._stages = []
+        return blocks
+
+    def metadata(self) -> List[Any]:
+        """BlockMetadata per output block, computed once and cached."""
+        if self._out_meta is None:
+            self._out_meta = get_metadata(self.execute())
+        return self._out_meta
+
+
+def get_metadata(block_refs: List[Any]) -> List[Any]:
+    """Fetch BlockMetadata for each block via small remote tasks."""
+    import ray_tpu
+
+    def _meta(block):
+        return BlockAccessor.for_block(block).get_metadata()
+
+    meta_task = ray_tpu.remote(_meta)
+    return ray_tpu.get([meta_task.remote(b) for b in block_refs])
