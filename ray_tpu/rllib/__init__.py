@@ -1,0 +1,33 @@
+"""ray_tpu.rllib — reinforcement learning on JAX/TPU.
+
+Reference analogue: rllib/ (Algorithm, RolloutWorker/WorkerSet,
+SampleBatch, policies, replay buffers). Policies are flax modules with
+jitted losses (PPO clipped surrogate, IMPALA V-trace, DQN double-Q);
+rollouts run on CPU actors with one batched jitted forward per vector-env
+step.
+"""
+
+from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
+                                        convert_ma_batch_to_sample_batch)
+from ray_tpu.rllib.env import (Box, CartPoleEnv, Discrete, PendulumEnv,
+                               VectorEnv, make_env)
+from ray_tpu.rllib.models import MLPNet, AtariCNN, make_model
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.postprocessing import compute_advantages
+from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
+                                          ReplayBuffer)
+from ray_tpu.rllib.rollout_worker import (RolloutWorker, WorkerSet,
+                                          synchronous_parallel_sample)
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms import (DQN, DQNConfig, IMPALA, IMPALAConfig,
+                                      PPO, PPOConfig)
+
+__all__ = [
+    "SampleBatch", "MultiAgentBatch", "convert_ma_batch_to_sample_batch",
+    "Box", "Discrete", "CartPoleEnv", "PendulumEnv", "VectorEnv",
+    "make_env", "MLPNet", "AtariCNN", "make_model", "JaxPolicy",
+    "compute_advantages", "ReplayBuffer", "PrioritizedReplayBuffer",
+    "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
+    "DQNConfig", "IMPALA", "IMPALAConfig",
+]

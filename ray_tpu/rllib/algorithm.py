@@ -1,0 +1,198 @@
+"""Algorithm + AlgorithmConfig — the RL training driver.
+
+Reference analogue: rllib/algorithms/algorithm.py:142 (step :706,
+training_step :1284) and algorithm_config.py (fluent builder). Algorithm
+subclasses Tune's Trainable so ``Tuner(PPO, ...)`` works exactly as in the
+reference (§3.6 step 1).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.rollout_worker import WorkerSet
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (reference: algorithm_config.py)."""
+
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        self._config: Dict[str, Any] = {
+            "env": None,
+            "env_config": {},
+            "num_workers": 0,
+            "num_envs_per_worker": 1,
+            "num_cpus_per_worker": 1,
+            "rollout_fragment_length": 200,
+            "train_batch_size": 4000,
+            "gamma": 0.99,
+            "lr": 5e-5,
+            "grad_clip": None,
+            "seed": 0,
+            "explore": True,
+            "model": {},
+            "min_sample_timesteps_per_iteration": 0,
+        }
+
+    # fluent sections, mirroring the reference's grouping
+    def environment(self, env=None, *, env_config=None, **kw):
+        if env is not None:
+            self._config["env"] = env
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        self._config.update(kw)
+        return self
+
+    def rollouts(self, **kw):
+        self._config.update(kw)
+        return self
+
+    def training(self, **kw):
+        self._config.update(kw)
+        return self
+
+    def resources(self, **kw):
+        self._config.update(kw)
+        return self
+
+    def debugging(self, *, seed=None, **kw):
+        if seed is not None:
+            self._config["seed"] = seed
+        self._config.update(kw)
+        return self
+
+    def framework(self, *_a, **_kw):  # always jax here
+        return self
+
+    def update_from_dict(self, d: Dict[str, Any]):
+        self._config.update(d)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self._config)
+
+    def __getitem__(self, k):
+        return self._config[k]
+
+    def get(self, k, default=None):
+        return self._config.get(k, default)
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self._config["env"] = env
+        assert self.algo_class is not None, "no algo_class bound"
+        return self.algo_class(config=self.to_dict())
+
+
+class Algorithm(Trainable):
+    """Trainable RL algorithm: owns a WorkerSet, steps = sample + learn."""
+
+    _policy_cls = None  # set by subclasses
+    _default_config_cls = AlgorithmConfig
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls._default_config_cls(cls)
+
+    def setup(self, config: Dict[str, Any]):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = base
+        if self.config.get("env") is None:
+            raise ValueError("config['env'] is required")
+        self.workers = WorkerSet(self.config, self._policy_cls,
+                                 self.config.get("num_workers", 0))
+        self._iteration = 0
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+        self._t_start = time.time()
+
+    # ---- Trainable API ----
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        results = self.training_step()
+        self._iteration += 1
+        metrics = self._collect_rollout_metrics()
+        sps = results.get("num_env_steps_sampled_this_iter", 0) / max(
+            1e-9, time.time() - t0)
+        out = {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+            "num_env_steps_sampled": self._timesteps_total,
+            "env_steps_per_sec": sps,
+            "time_total_s": time.time() - self._t_start,
+            **metrics,
+            **results,
+        }
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _collect_rollout_metrics(self,
+                                 window: int = 100) -> Dict[str, Any]:
+        for m in self.workers.collect_metrics():
+            self._episode_reward_window.extend(m["episode_rewards"])
+        self._episode_reward_window = self._episode_reward_window[-window:]
+        rw = self._episode_reward_window
+        return {
+            "episode_reward_mean": float(np.mean(rw)) if rw else np.nan,
+            "episode_reward_max": float(np.max(rw)) if rw else np.nan,
+            "episode_reward_min": float(np.min(rw)) if rw else np.nan,
+            "episodes_total": len(rw),
+        }
+
+    def get_policy(self):
+        return self.workers.local_worker.policy
+
+    def compute_single_action(self, obs, explore: bool = False):
+        actions, _ = self.get_policy().compute_actions(
+            np.asarray(obs)[None], explore=explore)
+        return actions[0]
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy evaluation rollouts on a fresh env."""
+        from ray_tpu.rllib.env import make_env
+        env = make_env(self.config["env"], self.config.get("env_config"))
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                a = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            rewards.append(total)
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
+
+    # ---- checkpointing (Trainable hooks) ----
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {
+            "policy_state": self.workers.local_worker.get_policy_state(),
+            "iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+            "config": {k: v for k, v in self.config.items()
+                       if not callable(v)},
+        }
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        self.workers.local_worker.set_policy_state(state["policy_state"])
+        self._iteration = state.get("iteration", 0)
+        self._timesteps_total = state.get("timesteps_total", 0)
+        self.workers.sync_weights()
+
+    def cleanup(self):
+        self.workers.stop()

@@ -1,0 +1,168 @@
+"""IMPALA — importance-weighted actor-learner with V-trace.
+
+Reference analogue: rllib/algorithms/impala/ (+ vtrace_torch.py, async
+learner queues in execution/learner_thread.py). TPU-first shape: actors
+sample asynchronously (futures held open per worker, reaped with
+``ray_tpu.wait``); the learner runs one jitted program in which V-trace is
+a ``lax.scan`` in reverse over the (time-ordered) batch, cut at episode /
+fragment boundaries — no Python loop touches the device path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def vtrace_scan(behaviour_logp, target_logp, rewards, values, next_values,
+                terms, cuts, gamma, clip_rho=1.0, clip_c=1.0):
+    """V-trace targets over a flat time-ordered sequence.
+
+    ``cuts`` marks the last row of each contiguous fragment (episode end or
+    truncation) — the reverse accumulator resets there, and ``next_values``
+    supplies the bootstrap. Pure function, safe under jit.
+    """
+    rho = jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_rho)
+    c = jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_c)
+    not_term = 1.0 - terms
+    deltas = rho * (rewards + gamma * not_term * next_values - values)
+    cont = gamma * (1.0 - cuts)
+
+    def backward(acc, xs):
+        delta, c_t, cont_t = xs
+        acc = delta + cont_t * c_t * acc
+        return acc, acc
+
+    _, acc = jax.lax.scan(backward, jnp.float32(0.0),
+                          (deltas, c, cont), reverse=True)
+    vs = values + acc
+    # vs_{t+1}: within a fragment use the next row's vs; at cuts fall back
+    # to the bootstrap value.
+    vs_next = jnp.concatenate([vs[1:], next_values[-1:]])
+    vs_next = jnp.where(cuts > 0, next_values, vs_next)
+    pg_adv = rho * (rewards + gamma * not_term * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALAPolicy(JaxPolicy):
+    def loss(self, params, batch):
+        cfg = self.config
+        dist_inputs, values = self.model.apply(
+            {"params": params}, batch[SampleBatch.OBS])
+        _, next_values = self.model.apply(
+            {"params": params}, batch[SampleBatch.NEXT_OBS])
+        next_values = jax.lax.stop_gradient(next_values)
+        target_logp = self.dist_logp(dist_inputs,
+                                     batch[SampleBatch.ACTIONS])
+        vs, pg_adv = vtrace_scan(
+            batch[SampleBatch.ACTION_LOGP], target_logp,
+            batch[SampleBatch.REWARDS], jax.lax.stop_gradient(values),
+            next_values,
+            batch[SampleBatch.DONES].astype(jnp.float32),
+            batch["cuts"].astype(jnp.float32),
+            cfg.get("gamma", 0.99),
+            clip_rho=cfg.get("vtrace_clip_rho_threshold", 1.0),
+            clip_c=cfg.get("vtrace_clip_c_threshold", 1.0))
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = jnp.mean(self.dist_entropy(dist_inputs))
+        total = (pg_loss
+                 + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                 - cfg.get("entropy_coeff", 0.01) * entropy)
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_vtrace_adv": jnp.mean(pg_adv)}
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self._config.update({
+            "lr": 5e-4,
+            "rollout_fragment_length": 50,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "vtrace_clip_rho_threshold": 1.0,
+            "vtrace_clip_c_threshold": 1.0,
+            "grad_clip": 40.0,
+            "num_workers": 1,
+            "broadcast_interval": 1,
+            "max_sample_batches_per_iter": 8,
+        })
+
+
+def _mark_cuts(batch: SampleBatch) -> SampleBatch:
+    """Add the 'cuts' column: 1 on the last row of every contiguous
+    per-episode fragment."""
+    cuts = np.zeros(batch.count, np.float32)
+    offset = 0
+    for frag in batch.split_by_episode():
+        offset += frag.count
+        cuts[offset - 1] = 1.0
+    batch["cuts"] = cuts
+    return batch
+
+
+class IMPALA(Algorithm):
+    _policy_cls = IMPALAPolicy
+    _default_config_cls = IMPALAConfig
+
+    def setup(self, config):
+        super().setup(config)
+        self._in_flight: Dict[Any, Any] = {}  # future -> worker
+        self._learn_count = 0
+
+    def _launch(self, worker):
+        fut = worker.sample.remote()
+        self._in_flight[fut] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        stats: Dict[str, float] = {}
+        sampled = 0
+        if not self.workers.remote_workers:
+            # degenerate sync path (num_workers=0)
+            batch = _mark_cuts(self.workers.local_worker.sample())
+            stats = policy.learn_on_batch(batch)
+            sampled = batch.count
+        else:
+            for w in self.workers.remote_workers:
+                if w not in self._in_flight.values():
+                    self._launch(w)
+            n_target = cfg.get("max_sample_batches_per_iter", 8)
+            reaped = 0
+            while reaped < n_target:
+                ready, _ = ray_tpu.wait(list(self._in_flight),
+                                        num_returns=1, timeout=60.0)
+                if not ready:
+                    break
+                fut = ready[0]
+                worker = self._in_flight.pop(fut)
+                batch = _mark_cuts(ray_tpu.get(fut))
+                stats = policy.learn_on_batch(batch)
+                sampled += batch.count
+                self._learn_count += 1
+                # async weight push, then relaunch sampling on that actor
+                if self._learn_count % cfg.get("broadcast_interval", 1) == 0:
+                    worker.set_weights.remote(
+                        ray_tpu.put(policy.get_weights()))
+                self._launch(worker)
+                reaped += 1
+        self._timesteps_total += sampled
+        return {
+            "num_env_steps_sampled_this_iter": sampled,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def cleanup(self):
+        self._in_flight.clear()
+        super().cleanup()

@@ -1,0 +1,121 @@
+"""PPO — proximal policy optimization with a jitted clipped-surrogate loss.
+
+Reference analogue: rllib/algorithms/ppo/ppo.py:286 (training_step :311)
+and ppo_torch_policy.py (loss). TPU-first: the whole
+loss→grad→clip→adam-update is ONE compiled XLA program with donated
+state; epochs × minibatches re-enter the same executable (fixed shapes cut
+by ``SampleBatch.minibatches``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.rollout_worker import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class PPOPolicy(JaxPolicy):
+    def postprocess_trajectory(self, batch):
+        from ray_tpu.rllib.postprocessing import \
+            compute_gae_for_sample_batch
+        return compute_gae_for_sample_batch(
+            self, batch, self.config.get("gamma", 0.99),
+            self.config.get("lambda", 0.95))
+
+    def loss(self, params, batch):
+        cfg = self.config
+        # rows added by SampleBatch.pad_to carry zero weight
+        mask = batch.get("_valid_mask")
+        if mask is None:
+            mask = jnp.ones_like(batch[SampleBatch.ACTION_LOGP])
+        denom = jnp.maximum(mask.sum(), 1.0)
+
+        def mmean(x):
+            return jnp.sum(x * mask) / denom
+
+        dist_inputs, vf = self.model.apply(
+            {"params": params}, batch[SampleBatch.OBS])
+        logp = self.dist_logp(dist_inputs, batch[SampleBatch.ACTIONS])
+        old_logp = batch[SampleBatch.ACTION_LOGP]
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv_mean = mmean(adv)
+        adv_std = jnp.sqrt(jnp.maximum(mmean((adv - adv_mean) ** 2), 0.0))
+        adv = (adv - adv_mean) / (adv_std + 1e-8)
+        ratio = jnp.exp(logp - old_logp)
+        clip = cfg.get("clip_param", 0.3)
+        surrogate = jnp.minimum(
+            adv * ratio,
+            adv * jnp.clip(ratio, 1.0 - clip, 1.0 + clip))
+        # value clipping: squared error clamped at vf_clip_param, as the
+        # reference torch policy does (ppo_torch_policy.py)
+        vf_clip = cfg.get("vf_clip_param", 10.0)
+        targets = batch[SampleBatch.VALUE_TARGETS]
+        vf_err = jnp.clip((vf - targets) ** 2, 0.0, vf_clip)
+        entropy = self.dist_entropy(dist_inputs)
+        # approximate KL against the behavior logp for reporting/early stop
+        kl = mmean(old_logp - logp)
+        total = mmean(
+            -surrogate
+            + cfg.get("vf_loss_coeff", 1.0) * vf_err
+            - cfg.get("entropy_coeff", 0.0) * entropy)
+        return total, {
+            "policy_loss": -mmean(surrogate),
+            "vf_loss": mmean(vf_err),
+            "entropy": mmean(entropy),
+            "kl": kl,
+        }
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self._config.update({
+            "lr": 3e-4,
+            "lambda": 0.95,
+            "clip_param": 0.3,
+            "vf_clip_param": 10.0,
+            "vf_loss_coeff": 1.0,
+            "entropy_coeff": 0.0,
+            "num_sgd_iter": 10,
+            "sgd_minibatch_size": 128,
+            "train_batch_size": 4000,
+            "grad_clip": None,
+            "kl_target": 0.01,
+        })
+
+
+class PPO(Algorithm):
+    _policy_cls = PPOPolicy
+    _default_config_cls = PPOConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        # 1. sample (reference: ppo.py:318 synchronous_parallel_sample)
+        train_batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=cfg["train_batch_size"])
+        sampled_steps = train_batch.count
+        self._timesteps_total += sampled_steps
+        # 2. minibatch SGD epochs on the local (learner) policy
+        policy = self.workers.local_worker.policy
+        rng = np.random.default_rng(cfg.get("seed", 0) + self._iteration)
+        stats: Dict[str, float] = {}
+        mb = cfg["sgd_minibatch_size"]
+        if train_batch.count < mb:
+            # padded rows carry _valid_mask=0 and are ignored by the loss
+            train_batch = train_batch.pad_to(mb)
+        for _ in range(cfg["num_sgd_iter"]):
+            for minibatch in train_batch.minibatches(mb, rng=rng):
+                stats = policy.learn_on_batch(minibatch)
+        # 3. broadcast new weights to rollout workers (ppo.py:345)
+        self.workers.sync_weights()
+        return {
+            "num_env_steps_sampled_this_iter": sampled_steps,
+            "info": {"learner": {"default_policy": stats}},
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }

@@ -1,0 +1,144 @@
+"""Model catalog: flax policy/value networks.
+
+Reference analogue: rllib/models/catalog.py + models/torch/ — but built as
+flax modules whose forward is shape-static and jit/pjit-friendly. Conv
+stacks use NHWC (TPU-native layout) and compute in bfloat16 with float32
+heads where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env import Box, Discrete
+
+
+class MLPNet(nn.Module):
+    """MLP with policy logits + value heads
+    (reference: rllib/models/torch/fcnet.py). Value branch is a separate
+    trunk by default (the reference's PPO `vf_share_layers=False`) so the
+    large-magnitude value loss can't wreck the policy features."""
+
+    num_outputs: int
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+    free_log_std: bool = False  # continuous: state-independent log-std
+    vf_share_layers: bool = False
+
+    @nn.compact
+    def __call__(self, obs):
+        act = {"tanh": nn.tanh, "relu": nn.relu, "swish": nn.swish}[
+            self.activation]
+        x = obs.astype(jnp.float32)
+        x = x.reshape((x.shape[0], -1))
+
+        def trunk(inp, name):
+            h_out = inp
+            for i, h in enumerate(self.hiddens):
+                h_out = act(nn.Dense(
+                    h, kernel_init=nn.initializers.orthogonal(np.sqrt(2)),
+                    name=f"{name}_{i}")(h_out))
+            return h_out
+
+        pi = trunk(x, "pi")
+        vf = pi if self.vf_share_layers else trunk(x, "vf")
+        logits = nn.Dense(self.num_outputs,
+                          kernel_init=nn.initializers.orthogonal(0.01))(pi)
+        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0))(vf)
+        if self.free_log_std:
+            log_std = self.param("log_std", nn.initializers.zeros,
+                                 (self.num_outputs,))
+            logits = jnp.concatenate(
+                [logits, jnp.broadcast_to(log_std, logits.shape)], axis=-1)
+        return logits, value[..., 0]
+
+
+class AtariCNN(nn.Module):
+    """Nature-DQN conv trunk in NHWC/bfloat16 for the MXU
+    (reference: rllib/models/torch/visionnet.py)."""
+
+    num_outputs: int
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.compute_dtype) / 255.0
+        for feat, kern, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.relu(nn.Conv(feat, (kern, kern), strides=(stride, stride),
+                                dtype=self.compute_dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.compute_dtype)(x))
+        x = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_outputs)(x)
+        value = nn.Dense(1)(x)
+        return logits, value[..., 0]
+
+
+def num_action_outputs(action_space) -> Tuple[int, bool]:
+    """(num distribution inputs before log-std doubling, is_discrete)."""
+    if isinstance(action_space, Discrete):
+        return action_space.n, True
+    return int(np.prod(action_space.shape)), False
+
+
+def make_model(obs_space, action_space,
+               model_config: Optional[Dict[str, Any]] = None) -> nn.Module:
+    """Pick a network for the given spaces (reference:
+    models/catalog.py ModelCatalog.get_model_v2)."""
+    model_config = model_config or {}
+    n_out, discrete = num_action_outputs(action_space)
+    if len(obs_space.shape) == 3:
+        return AtariCNN(num_outputs=n_out)
+    return MLPNet(
+        num_outputs=n_out,
+        hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
+        activation=model_config.get("fcnet_activation", "tanh"),
+        free_log_std=not discrete,
+        vf_share_layers=model_config.get("vf_share_layers", False))
+
+
+# ---- action distributions (functional, jit-safe) ----
+
+
+def categorical_sample(rng, logits):
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def categorical_logp(logits, actions):
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(
+        logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def categorical_entropy(logits):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def diag_gaussian_split(dist_inputs):
+    mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+    return mean, jnp.clip(log_std, -20.0, 2.0)
+
+
+def diag_gaussian_sample(rng, dist_inputs):
+    mean, log_std = diag_gaussian_split(dist_inputs)
+    return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+
+def diag_gaussian_logp(dist_inputs, actions):
+    mean, log_std = diag_gaussian_split(dist_inputs)
+    actions = actions.reshape(mean.shape)
+    var = jnp.exp(2 * log_std)
+    return jnp.sum(
+        -0.5 * ((actions - mean) ** 2 / var)
+        - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+
+def diag_gaussian_entropy(dist_inputs):
+    _, log_std = diag_gaussian_split(dist_inputs)
+    return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)

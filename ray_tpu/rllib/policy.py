@@ -1,0 +1,182 @@
+"""JaxPolicy — the TPU-native policy abstraction.
+
+Reference analogue: rllib/policy/torch_policy_v2.py:62 (compute_actions
+:499, loss :212, learn_on_batch :603). Differences by design:
+
+- ``compute_actions`` is ONE jitted batched forward over the whole vector
+  env (no per-env Python loop).
+- ``learn_on_batch`` is a single jitted (loss → grad → optax update)
+  program with donated optimizer/param state; minibatch SGD epochs run as
+  repeated calls into the same compiled program (fixed shapes).
+- Weights are pytrees; ``get_weights`` pulls to host numpy for object-store
+  broadcast to rollout workers (reference: WorkerSet.sync_weights).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.env import Discrete
+from ray_tpu.rllib.models import (
+    categorical_entropy, categorical_logp, categorical_sample,
+    diag_gaussian_entropy, diag_gaussian_logp, diag_gaussian_sample,
+    make_model)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _stats_to_host(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """Scalars → python floats; per-sample arrays (e.g. TD errors for
+    prioritized replay) stay as numpy."""
+    out = {}
+    for k, v in stats.items():
+        if getattr(v, "ndim", 0) == 0:
+            out[k] = float(v)
+        else:
+            out[k] = np.asarray(v)
+    return out
+
+
+class JaxPolicy:
+    """A policy = flax model + action distribution + optax optimizer +
+    a jitted loss. Subclasses override :meth:`loss`."""
+
+    def __init__(self, obs_space, action_space, config: Dict[str, Any]):
+        self.observation_space = obs_space
+        self.action_space = action_space
+        self.config = config
+        self.discrete = isinstance(action_space, Discrete)
+        self.model = make_model(obs_space, action_space,
+                                config.get("model"))
+        seed = config.get("seed") or 0
+        self._rng = jax.random.PRNGKey(seed)
+        obs_dim = obs_space.shape or (1,)
+        dummy = jnp.zeros((1, *obs_dim), jnp.float32)
+        self.params = self.model.init(self._next_rng(), dummy)["params"]
+        self.optimizer = self._make_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._jit_actions = jax.jit(self._compute_actions_impl)
+        self._jit_update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+        self._jit_value = jax.jit(self._value_impl)
+        self.global_timestep = 0
+
+    # ---- wiring ----
+
+    def _make_optimizer(self):
+        lr = self.config.get("lr", 5e-5)
+        clip = self.config.get("grad_clip")
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(lr))
+        return optax.chain(*chain)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- inference ----
+
+    def _compute_actions_impl(self, params, obs, rng, explore):
+        dist_inputs, vf = self.model.apply({"params": params}, obs)
+        if self.discrete:
+            stoch = categorical_sample(rng, dist_inputs)
+            greedy = jnp.argmax(dist_inputs, axis=-1)
+            actions = jnp.where(explore, stoch, greedy)
+            logp = categorical_logp(dist_inputs, actions)
+        else:
+            stoch = diag_gaussian_sample(rng, dist_inputs)
+            greedy, _ = jnp.split(dist_inputs, 2, axis=-1)
+            actions = jnp.where(explore, stoch, greedy)
+            logp = diag_gaussian_logp(dist_inputs, actions)
+        return actions, logp, dist_inputs, vf
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        obs = jnp.asarray(obs)
+        actions, logp, dist_inputs, vf = self._jit_actions(
+            self.params, obs, self._next_rng(), explore)
+        extras = {
+            SampleBatch.ACTION_LOGP: np.asarray(logp),
+            SampleBatch.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
+            SampleBatch.VF_PREDS: np.asarray(vf),
+        }
+        return np.asarray(actions), extras
+
+    def _value_impl(self, params, obs):
+        _, vf = self.model.apply({"params": params}, obs)
+        return vf
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._jit_value(self.params, jnp.asarray(obs)))
+
+    # ---- action-dist helpers usable inside jitted losses ----
+
+    def dist_logp(self, dist_inputs, actions):
+        if self.discrete:
+            return categorical_logp(dist_inputs, actions)
+        return diag_gaussian_logp(dist_inputs, actions)
+
+    def dist_entropy(self, dist_inputs):
+        if self.discrete:
+            return categorical_entropy(dist_inputs)
+        return diag_gaussian_entropy(dist_inputs)
+
+    # ---- learning ----
+
+    def postprocess_trajectory(self, batch: SampleBatch) -> SampleBatch:
+        """Per-episode-fragment hook run worker-side after sampling
+        (reference: Policy.postprocess_trajectory). Default: no-op;
+        PPO overrides to compute GAE."""
+        return batch
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray]
+             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """Return (scalar loss, stats dict). Traced under jit — must be
+        pure, fixed-shape, no Python control flow on traced values."""
+        raise NotImplementedError
+
+    def _update_impl(self, params, opt_state, batch):
+        (loss_val, stats), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats = dict(stats)
+        stats["total_loss"] = loss_val
+        stats["grad_gnorm"] = optax.global_norm(grads)
+        return params, opt_state, stats
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if isinstance(v, np.ndarray) and v.dtype != object}
+        self.params, self.opt_state, stats = self._jit_update(
+            self.params, self.opt_state, jbatch)
+        self.global_timestep += batch.count
+        return _stats_to_host(stats)
+
+    # ---- weights ----
+
+    def get_weights(self) -> Dict[str, Any]:
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict[str, Any]):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "weights": self.get_weights(),
+            "opt_state": jax.device_get(self.opt_state),
+            "global_timestep": self.global_timestep,
+        }
+
+    def set_state(self, state: Dict[str, Any]):
+        self.set_weights(state["weights"])
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"],
+            is_leaf=lambda x: isinstance(x, (np.ndarray, np.generic)))
+        self.global_timestep = state.get("global_timestep", 0)

@@ -1,0 +1,214 @@
+"""RolloutWorker + WorkerSet — sampling actors.
+
+Reference analogue: rllib/evaluation/rollout_worker.py:153 (sample :856)
+and worker_set.py:77 (sync_weights :381). TPU-first shape: the worker
+steps a synchronous VectorEnv and runs ONE batched jitted policy forward
+per env-step; fragments are cut at ``rollout_fragment_length`` and GAE is
+computed worker-side so the learner only sees ready-to-train columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class RolloutWorker:
+    """Samples experience from a vectorized env with a local policy copy."""
+
+    def __init__(self, config: Dict[str, Any], policy_cls,
+                 worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        env_fn = lambda: make_env(config["env"], config.get("env_config"))
+        self.vector_env = VectorEnv(
+            env_fn, config.get("num_envs_per_worker", 1),
+            seed=(config.get("seed") or 0) * 10_000 + worker_index)
+        self.policy = policy_cls(
+            self.vector_env.observation_space,
+            self.vector_env.action_space, config)
+        self._obs = self.vector_env.reset_all()
+        n = self.vector_env.num_envs
+        self._eps_ids = np.arange(n, dtype=np.int64) * 1_000_000 \
+            + worker_index
+        self._next_eps = self._eps_ids.max() + 1
+        self._episode_rewards = np.zeros(n, np.float64)
+        self._episode_lens = np.zeros(n, np.int64)
+        self._completed_rewards: List[float] = []
+        self._completed_lens: List[int] = []
+
+    def sample(self) -> SampleBatch:
+        """Collect ``rollout_fragment_length`` steps from every sub-env."""
+        frag_len = self.config.get("rollout_fragment_length", 200)
+        n_envs = self.vector_env.num_envs
+        cols: Dict[str, list] = {
+            k: [] for k in (SampleBatch.OBS, SampleBatch.ACTIONS,
+                            SampleBatch.REWARDS, SampleBatch.DONES,
+                            SampleBatch.TRUNCATEDS, SampleBatch.NEXT_OBS,
+                            SampleBatch.EPS_ID, SampleBatch.ACTION_LOGP,
+                            SampleBatch.ACTION_DIST_INPUTS,
+                            SampleBatch.VF_PREDS)}
+        explore = self.config.get("explore", True)
+        for _ in range(frag_len):
+            actions, extras = self.policy.compute_actions(
+                self._obs, explore=explore)
+            next_obs, rews, terms, truncs, infos = self.vector_env.step(
+                actions)
+            true_next = next_obs.copy()
+            for i, info in enumerate(infos):
+                if "terminal_observation" in info:
+                    true_next[i] = info["terminal_observation"]
+            cols[SampleBatch.OBS].append(self._obs.copy())
+            cols[SampleBatch.ACTIONS].append(actions)
+            cols[SampleBatch.REWARDS].append(rews)
+            cols[SampleBatch.DONES].append(terms)
+            cols[SampleBatch.TRUNCATEDS].append(truncs)
+            cols[SampleBatch.NEXT_OBS].append(true_next)
+            cols[SampleBatch.EPS_ID].append(self._eps_ids.copy())
+            for k in (SampleBatch.ACTION_LOGP,
+                      SampleBatch.ACTION_DIST_INPUTS,
+                      SampleBatch.VF_PREDS):
+                cols[k].append(extras[k])
+            self._episode_rewards += rews
+            self._episode_lens += 1
+            finished = terms | truncs
+            for i in np.nonzero(finished)[0]:
+                self._completed_rewards.append(
+                    float(self._episode_rewards[i]))
+                self._completed_lens.append(int(self._episode_lens[i]))
+                self._episode_rewards[i] = 0.0
+                self._episode_lens[i] = 0
+                self._eps_ids[i] = self._next_eps
+                self._next_eps += 1
+            self._obs = next_obs
+
+        # [T, N, ...] → per-env trajectories → policy postprocess (GAE
+        # for PPO, no-op for DQN/IMPALA) → concat.
+        stacked = {k: np.stack(v) for k, v in cols.items()}
+        frags = []
+        for i in range(n_envs):
+            env_cols = SampleBatch(
+                {k: v[:, i] for k, v in stacked.items()})
+            for ep in env_cols.split_by_episode():
+                frags.append(self.policy.postprocess_trajectory(ep))
+        return SampleBatch.concat_samples(frags)
+
+    def sample_with_count(self):
+        b = self.sample()
+        return b, b.count
+
+    # ---- weights / metrics / state ----
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights):
+        self.policy.set_weights(weights)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_rewards": list(self._completed_rewards),
+            "episode_lens": list(self._completed_lens),
+        }
+        self._completed_rewards = []
+        self._completed_lens = []
+        return out
+
+    def apply(self, fn, *args):
+        """Run ``fn(policy, *args)`` on this worker's policy — used to
+        propagate learner-side knobs (e.g. DQN epsilon) to remote actors."""
+        return fn(self.policy, *args)
+
+    def set_exploration(self, **attrs):
+        for k, v in attrs.items():
+            setattr(self.policy, k, v)
+
+    def get_policy_state(self):
+        return self.policy.get_state()
+
+    def set_policy_state(self, state):
+        self.policy.set_state(state)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def stop(self):
+        pass
+
+
+class WorkerSet:
+    """Local learner worker + N remote rollout actors
+    (reference: rllib/evaluation/worker_set.py:77)."""
+
+    def __init__(self, config: Dict[str, Any], policy_cls,
+                 num_workers: int):
+        self.config = config
+        self.policy_cls = policy_cls
+        self.local_worker = RolloutWorker(config, policy_cls,
+                                          worker_index=0)
+        self.remote_workers: List[Any] = []
+        if num_workers > 0:
+            remote_cls = ray_tpu.remote(
+                num_cpus=config.get("num_cpus_per_worker", 1))(RolloutWorker)
+            self.remote_workers = [
+                remote_cls.remote(config, policy_cls, worker_index=i + 1)
+                for i in range(num_workers)]
+
+    def sync_weights(self):
+        """Broadcast learner weights via ONE object-store put
+        (reference: worker_set.py:381 + ppo.py:345)."""
+        if not self.remote_workers:
+            return
+        ref = ray_tpu.put(self.local_worker.get_weights())
+        ray_tpu.get([w.set_weights.remote(ref)
+                     for w in self.remote_workers])
+
+    def set_exploration(self, **attrs):
+        """Propagate exploration knobs (e.g. epsilon) to every policy copy,
+        local and remote."""
+        self.local_worker.set_exploration(**attrs)
+        if self.remote_workers:
+            ray_tpu.get([w.set_exploration.remote(**attrs)
+                         for w in self.remote_workers])
+
+    def sample_all(self) -> List[SampleBatch]:
+        if not self.remote_workers:
+            return [self.local_worker.sample()]
+        return ray_tpu.get([w.sample.remote() for w in self.remote_workers])
+
+    def collect_metrics(self) -> List[Dict[str, Any]]:
+        out = [self.local_worker.get_metrics()]
+        if self.remote_workers:
+            out += ray_tpu.get(
+                [w.get_metrics.remote() for w in self.remote_workers])
+        return out
+
+    def stop(self):
+        for w in self.remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+def synchronous_parallel_sample(worker_set: WorkerSet,
+                                max_env_steps: Optional[int] = None
+                                ) -> SampleBatch:
+    """Keep sampling rounds until ``max_env_steps`` collected
+    (reference: rllib/execution/rollout_ops.py:21)."""
+    batches: List[SampleBatch] = []
+    steps = 0
+    target = max_env_steps or 1
+    while steps < target:
+        round_batches = worker_set.sample_all()
+        for b in round_batches:
+            batches.append(b)
+            steps += b.count
+        if max_env_steps is None:
+            break
+    return SampleBatch.concat_samples(batches)
