@@ -102,6 +102,23 @@ class ObjectRef:
         ref_serialization.record_ref((self._id.hex(), self._owner_address))
         return (_deserialize_ref, (self._id.binary(), self._owner_address))
 
+    def on_done(self, cb) -> bool:
+        """Fire ``cb()`` (no value fetch) when the producing task
+        completes. Returns False when completion can't be tracked (e.g.
+        this process didn't submit the task) — caller must fall back."""
+        w = self._worker
+        if w is None or not w.connected:
+            return False
+        state = w.pending_tasks.get(self._id.task_id().hex())
+        if state is not None:
+            state.result_event.add_callback(cb)
+            return True
+        if (w.memory_store.contains(self._id)
+                or w.plasma.contains(self._id)):
+            cb()
+            return True
+        return False
+
     def future(self):
         """A concurrent.futures.Future resolved with the object's value."""
         from concurrent.futures import Future
@@ -270,6 +287,37 @@ def global_worker() -> "Worker":
     return _global_worker
 
 
+class _CallbackEvent(threading.Event):
+    """threading.Event that also fires one-shot callbacks on set() —
+    lets ObjectRef.on_done release resources (e.g. Serve router
+    backpressure slots) without a waiter thread per ref."""
+
+    def __init__(self):
+        super().__init__()
+        self._cbs: List = []
+        self._cb_lock = threading.Lock()
+
+    def add_callback(self, cb):
+        fire = False
+        with self._cb_lock:
+            if self.is_set():
+                fire = True
+            else:
+                self._cbs.append(cb)
+        if fire:
+            cb()
+
+    def set(self):
+        super().set()
+        with self._cb_lock:
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
 class PendingTaskState:
     __slots__ = ("spec", "retries_left", "return_ids", "done",
                  "result_event", "worker_address")
@@ -279,7 +327,7 @@ class PendingTaskState:
         self.retries_left = retries_left
         self.return_ids = return_ids
         self.done = False
-        self.result_event = threading.Event()
+        self.result_event = _CallbackEvent()
         self.worker_address = None
 
 

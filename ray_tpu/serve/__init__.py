@@ -1,0 +1,20 @@
+"""ray_tpu.serve — online model serving on actors.
+
+Reference analogue: serve/ (controller/proxy/router/replica, LongPoll,
+autoscaling, batching, deployment graphs). JAX-first serving: replicas
+host jitted callables; @serve.batch pads to power-of-two buckets so XLA
+compiles once per bucket, not per batch size.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, delete,
+                               deployment, get_deployment_handle, run,
+                               shutdown, start, status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.autoscaling import AutoscalingConfig
+
+__all__ = [
+    "deployment", "run", "start", "shutdown", "status", "delete",
+    "get_deployment_handle", "Deployment", "Application",
+    "DeploymentHandle", "batch", "AutoscalingConfig",
+]

@@ -1,0 +1,61 @@
+"""Replica autoscaling from queue-length metrics.
+
+Reference analogue: serve/_private/autoscaling_policy.py (policy on
+per-replica ongoing-request metrics from autoscaling_metrics.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    smoothing_factor: float = 1.0
+
+
+class AutoscalingPolicy:
+    """Desired replicas ∝ observed ongoing requests / target-per-replica,
+    with hysteresis delays so transient spikes don't flap the fleet."""
+
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        self._above_since = None
+        self._below_since = None
+
+    def get_decision(self, current_replicas: int,
+                     total_ongoing: float, now: float) -> int:
+        c = self.config
+        if current_replicas == 0:
+            return c.min_replicas
+        raw = total_ongoing / max(
+            c.target_num_ongoing_requests_per_replica, 1e-9)
+        desired = current_replicas + c.smoothing_factor * (
+            raw - current_replicas)
+        desired = int(min(max(math.ceil(desired), c.min_replicas),
+                          c.max_replicas))
+        if desired > current_replicas:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if now - self._above_since >= c.upscale_delay_s:
+                self._above_since = None
+                return desired
+            return current_replicas
+        if desired < current_replicas:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since >= c.downscale_delay_s:
+                self._below_since = None
+                return desired
+            return current_replicas
+        self._above_since = self._below_since = None
+        return current_replicas

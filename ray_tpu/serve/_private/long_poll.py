@@ -1,0 +1,83 @@
+"""Long-poll config propagation.
+
+Reference analogue: serve/_private/long_poll.py (LongPollHost:185,
+LongPollClient:68). The host lives inside the controller actor; clients
+issue blocking ``listen`` calls (served on the controller's thread pool)
+that return only when the keyed snapshot's version advances — push-like
+latency with pull-only plumbing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class LongPollHost:
+    """Versioned key→snapshot store with blocking listeners."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._versions: Dict[str, int] = {}
+        self._snapshots: Dict[str, Any] = {}
+
+    def notify_changed(self, key: str, snapshot: Any):
+        with self._cv:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._snapshots[key] = snapshot
+            self._cv.notify_all()
+
+    def listen(self, key: str, last_version: int,
+               timeout: float = 30.0) -> Tuple[int, Any]:
+        """Block until version(key) > last_version (or timeout); returns
+        (current_version, snapshot)."""
+        deadline = None
+        with self._cv:
+            while self._versions.get(key, 0) <= last_version:
+                if not self._cv.wait(timeout=timeout):
+                    break
+            return (self._versions.get(key, 0),
+                    self._snapshots.get(key))
+
+    def get(self, key: str) -> Tuple[int, Any]:
+        with self._lock:
+            return self._versions.get(key, 0), self._snapshots.get(key)
+
+
+class LongPollClient:
+    """Background thread repeatedly calling ``listen`` on the controller
+    and firing callbacks on change."""
+
+    def __init__(self, controller_handle, key: str,
+                 callback: Callable[[Any], None]):
+        import ray_tpu
+        self._ray = ray_tpu
+        self._controller = controller_handle
+        self._key = key
+        self._callback = callback
+        self._version = -1  # -1 so the first listen returns immediately
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                version, snapshot = self._ray.get(
+                    self._controller.listen_for_change.remote(
+                        self._key, self._version), timeout=60.0)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                self._stopped.wait(1.0)
+                continue
+            if version > self._version:
+                self._version = version
+                try:
+                    self._callback(snapshot)
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,93 @@
+"""Replica actor — hosts one copy of a deployment's user callable.
+
+Reference analogue: serve/_private/replica.py:250 (RayServeReplica,
+handle_request:494). Concurrency comes from the actor's thread pool
+(``max_concurrency`` = the deployment's ``max_concurrent_queries``);
+``num_ongoing_requests`` feeds both router backpressure and the
+controller's autoscaling policy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+
+class ReplicaActor:
+    """Generic wrapper the controller instantiates as an actor."""
+
+    def __init__(self, deployment_name: str, serialized_callable: bytes,
+                 init_args: tuple, init_kwargs: dict,
+                 user_config: Optional[Any] = None,
+                 version: str = ""):
+        import cloudpickle
+        self.deployment_name = deployment_name
+        self.version = version
+        fn_or_cls = cloudpickle.loads(serialized_callable)
+        if isinstance(fn_or_cls, type):
+            self.callable = fn_or_cls(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self.callable = fn_or_cls
+            self._is_function = True
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
+        self._total_requests = 0
+        self._total_errors = 0
+        self._latency_sum = 0.0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def handle_request(self, method_name: str, args: tuple,
+                       kwargs: dict) -> Any:
+        t0 = time.time()
+        with self._ongoing_lock:
+            self._ongoing += 1
+            self._total_requests += 1
+        try:
+            if self._is_function:
+                target = self.callable
+            else:
+                target = getattr(self.callable, method_name or "__call__")
+            return target(*args, **kwargs)
+        except Exception:
+            with self._ongoing_lock:
+                self._total_errors += 1
+            raise
+        finally:
+            with self._ongoing_lock:
+                self._ongoing -= 1
+                self._latency_sum += time.time() - t0
+
+    def reconfigure(self, user_config: Any):
+        """Apply a new user_config without restarting the replica
+        (reference: replica.py reconfigure path)."""
+        if not self._is_function and hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._ongoing_lock:
+            return {
+                "num_ongoing_requests": self._ongoing,
+                "total_requests": self._total_requests,
+                "total_errors": self._total_errors,
+                "latency_sum_s": self._latency_sum,
+            }
+
+    def check_health(self) -> str:
+        """Controller health probe; user callables may define their own
+        ``check_health`` raising on failure."""
+        if not self._is_function and hasattr(self.callable,
+                                             "check_health"):
+            self.callable.check_health()
+        return "ok"
+
+    def prepare_for_shutdown(self):
+        if not self._is_function and hasattr(self.callable, "__del__"):
+            try:
+                self.callable.__del__()
+            except Exception:
+                pass
+        return "ok"

@@ -1,0 +1,138 @@
+"""Client-side request router with backpressure.
+
+Reference analogue: serve/_private/router.py:261 (Router,
+assign_request:298) + the ReplicaSet power-of-queue logic (:62). Each
+handle/proxy owns a Router that long-polls the controller for the live
+replica membership and picks the least-loaded replica under
+``max_concurrent_queries``, counting its own in-flight requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.actor import get_actor_by_id
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+
+class ReplicaSet:
+    """Tracks live replicas of one deployment + per-replica in-flight."""
+
+    def __init__(self, deployment_name: str, max_concurrent_queries: int):
+        self.deployment_name = deployment_name
+        self.max_concurrent_queries = max_concurrent_queries
+        self._replicas: List[Any] = []       # actor handles
+        self._in_flight: Dict[str, int] = {}  # actor id hex -> count
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._rr = 0
+
+    def update_replicas(self, replicas: List[Any],
+                        max_concurrent_queries: Optional[int] = None):
+        with self._cv:
+            self._replicas = list(replicas)
+            if max_concurrent_queries:
+                self.max_concurrent_queries = max_concurrent_queries
+            live = {r._id_hex for r in self._replicas}
+            self._in_flight = {k: v for k, v in self._in_flight.items()
+                               if k in live}
+            self._cv.notify_all()
+
+    def assign(self, timeout: float = 30.0):
+        """Round-robin over replicas with < max_concurrent_queries of OUR
+        in-flight requests; blocks when all are saturated."""
+        deadline = time.time() + timeout
+        with self._cv:
+            while True:
+                n = len(self._replicas)
+                for off in range(n):
+                    r = self._replicas[(self._rr + off) % n] if n else None
+                    if r is None:
+                        break
+                    key = r._id_hex
+                    if (self._in_flight.get(key, 0)
+                            < self.max_concurrent_queries):
+                        self._rr = (self._rr + off + 1) % n
+                        self._in_flight[key] = \
+                            self._in_flight.get(key, 0) + 1
+                        return r
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no replica available for "
+                        f"{self.deployment_name!r} within {timeout}s "
+                        f"({n} replicas, all at "
+                        f"{self.max_concurrent_queries} in-flight)")
+                self._cv.wait(timeout=min(remaining, 1.0))
+
+    def release(self, replica):
+        with self._cv:
+            key = replica._id_hex
+            if key in self._in_flight:
+                self._in_flight[key] -= 1
+                if self._in_flight[key] <= 0:
+                    self._in_flight.pop(key)
+            self._cv.notify()
+
+
+class Router:
+    """Routes requests for many deployments; refreshed via long-poll."""
+
+    def __init__(self, controller_handle):
+        self._controller = controller_handle
+        self._sets: Dict[str, ReplicaSet] = {}
+        self._lock = threading.Lock()
+        self._poller = LongPollClient(
+            controller_handle, "route_table", self._on_update)
+        # seed synchronously so the first request doesn't race the poller
+        try:
+            _, snapshot = ray_tpu.get(
+                controller_handle.get_route_table.remote())
+            if snapshot:
+                self._on_update(snapshot)
+        except Exception:
+            pass
+
+    def _on_update(self, snapshot: Optional[Dict[str, Any]]):
+        if not snapshot:
+            return
+        with self._lock:
+            for name, info in snapshot.items():
+                replicas = [get_actor_by_id(h)
+                            for h in info["replicas"]]
+                s = self._sets.get(name)
+                if s is None:
+                    s = ReplicaSet(name, info["max_concurrent_queries"])
+                    self._sets[name] = s
+                s.update_replicas(replicas,
+                                  info["max_concurrent_queries"])
+            for gone in set(self._sets) - set(snapshot):
+                self._sets.pop(gone)
+
+    def replica_set(self, deployment_name: str) -> ReplicaSet:
+        with self._lock:
+            s = self._sets.get(deployment_name)
+        if s is None:
+            # force one refresh for deployments created after seeding
+            _, snapshot = ray_tpu.get(
+                self._controller.get_route_table.remote())
+            self._on_update(snapshot)
+            with self._lock:
+                s = self._sets.get(deployment_name)
+        if s is None:
+            raise KeyError(f"unknown deployment {deployment_name!r}")
+        return s
+
+    def assign_request(self, deployment_name: str, method_name: str,
+                       args: tuple, kwargs: dict):
+        """Pick a replica, fire the call, return (ObjectRef, done_cb)."""
+        rs = self.replica_set(deployment_name)
+        replica = rs.assign()
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+        return ref, lambda: rs.release(replica)
+
+    def stop(self):
+        self._poller.stop()

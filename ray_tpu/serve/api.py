@@ -1,0 +1,228 @@
+"""Public Serve API: @serve.deployment, serve.run, handles, status.
+
+Reference analogue: serve/api.py (deployment:251, run:455) and the
+Application/bind graph from python/ray/dag. Deployments are pickled
+callables shipped to the controller, which reconciles replica actors;
+``bind`` composes deployments by injecting DeploymentHandles for bound
+upstream deployments (the deployment-graph substrate).
+"""
+
+from __future__ import annotations
+
+import time
+
+import cloudpickle
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import (DeploymentHandle, _get_router,
+                                  _reset_router)
+
+_DEFAULT_HTTP_PORT = 8000
+
+
+class Application:
+    """A bound deployment DAG rooted at the ingress deployment."""
+
+    def __init__(self, root: "BoundDeployment"):
+        self.root = root
+
+    def _collect(self) -> List["BoundDeployment"]:
+        seen: Dict[str, BoundDeployment] = {}
+
+        def visit(node: BoundDeployment):
+            if node.deployment.name in seen:
+                return
+            seen[node.deployment.name] = node
+            for a in list(node.init_args) + list(
+                    node.init_kwargs.values()):
+                a = _unwrap(a)
+                if isinstance(a, BoundDeployment):
+                    visit(a)
+        visit(self.root)
+        return list(seen.values())
+
+
+class BoundDeployment:
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+def _unwrap(x):
+    """Applications passed as init args are their root bound node."""
+    return x.root if isinstance(x, Application) else x
+
+
+class Deployment:
+    def __init__(self, func_or_class: Union[Callable, type],
+                 name: str, config: Dict[str, Any]):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(BoundDeployment(self, args, kwargs))
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = dict(self.config)
+        name = kwargs.pop("name", self.name)
+        cfg.update(kwargs)
+        return Deployment(self.func_or_class, name, cfg)
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_concurrent_queries: int = 100,
+               user_config: Optional[Any] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               route_prefix: Optional[str] = None):
+    """@serve.deployment — mark a class/function as a deployment."""
+
+    def wrap(func_or_class):
+        return Deployment(
+            func_or_class,
+            name or func_or_class.__name__,
+            {
+                "num_replicas": num_replicas,
+                "max_concurrent_queries": max_concurrent_queries,
+                "user_config": user_config,
+                "autoscaling_config": autoscaling_config,
+                "ray_actor_options": ray_actor_options,
+                "route_prefix": route_prefix,
+            })
+
+    return wrap if _func_or_class is None else wrap(_func_or_class)
+
+
+def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
+          http_host: str = "127.0.0.1"):
+    """Start (or connect to) the Serve controller; http_port=None means
+    no HTTP ingress. An explicit port starts the proxy even when the
+    controller already exists."""
+    controller = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.ping.remote(), timeout=10.0)
+    except Exception:
+        controller = None
+    if controller is None:
+        controller_cls = ray_tpu.remote(
+            name=CONTROLLER_NAME, lifetime="detached",
+            max_concurrency=32)(ServeController)
+        controller = controller_cls.remote(http_port)
+        ray_tpu.get(controller.ping.remote(), timeout=30.0)
+    if http_port is not None:
+        try:
+            proxy = ray_tpu.get_actor("SERVE_PROXY")
+            ray_tpu.get(proxy.ping.remote(), timeout=10.0)
+        except Exception:
+            from ray_tpu.serve.http_proxy import HTTPProxyActor
+            proxy_cls = ray_tpu.remote(
+                name="SERVE_PROXY", lifetime="detached",
+                max_concurrency=64)(HTTPProxyActor)
+            proxy = proxy_cls.remote(CONTROLLER_NAME, http_host,
+                                     http_port)
+            ray_tpu.get(proxy.ping.remote(), timeout=30.0)
+    return controller
+
+
+def run(app: Union[Application, Deployment], *,
+        route_prefix: str = "/",
+        http_port: Optional[int] = _DEFAULT_HTTP_PORT,
+        _blocking_timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy an application; returns a handle to the ingress deployment
+    (reference: serve.run serve/api.py:455). ``http_port=None`` runs
+    handle-only (no HTTP ingress)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = start(http_port=http_port)
+    nodes = app._collect()
+    root_name = app.root.deployment.name
+    specs = []
+    for node in nodes:
+        dep = node.deployment
+        # bound upstream deployments become handles at init time
+        init_args = tuple(
+            DeploymentHandle(_unwrap(a).deployment.name, controller)
+            if isinstance(_unwrap(a), BoundDeployment) else a
+            for a in node.init_args)
+        init_kwargs = {
+            k: (DeploymentHandle(_unwrap(v).deployment.name, controller)
+                if isinstance(_unwrap(v), BoundDeployment) else v)
+            for k, v in node.init_kwargs.items()}
+        cfg = dict(dep.config)
+        cfg["name"] = dep.name
+        cfg["serialized_callable"] = cloudpickle.dumps(dep.func_or_class)
+        cfg["init_args"] = init_args
+        cfg["init_kwargs"] = init_kwargs
+        if dep.name == root_name and not cfg.get("route_prefix"):
+            cfg["route_prefix"] = route_prefix
+        specs.append(cfg)
+    ray_tpu.get(controller.deploy_application.remote(specs),
+                timeout=60.0)
+    _wait_healthy(controller, [s["name"] for s in specs],
+                  timeout=_blocking_timeout)
+    return DeploymentHandle(root_name, controller)
+
+
+def _wait_healthy(controller, names: List[str], timeout: float):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = ray_tpu.get(
+            controller.get_deployment_statuses.remote(), timeout=30.0)
+        if all(statuses.get(n, {}).get("status") == "HEALTHY"
+               for n in names):
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"deployments {names} not healthy in {timeout}s: "
+                       f"{statuses}")
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return DeploymentHandle(name, controller)
+
+
+def status() -> Dict[str, Any]:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return ray_tpu.get(
+            controller.get_deployment_statuses.remote(), timeout=30.0)
+    except Exception:
+        return {}
+
+
+def delete(names: Union[str, List[str]]):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    if isinstance(names, str):
+        names = [names]
+    ray_tpu.get(controller.delete_deployments.remote(names),
+                timeout=30.0)
+
+
+def shutdown():
+    """Tear down all deployments, the proxy, and the controller."""
+    _reset_router()
+    try:
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        try:
+            ray_tpu.get(proxy.shutdown.remote(), timeout=10.0)
+        except Exception:
+            pass
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
+        except Exception:
+            pass
+        time.sleep(0.5)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

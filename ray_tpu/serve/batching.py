@@ -1,0 +1,131 @@
+"""Request batching — @serve.batch.
+
+Reference analogue: serve/batching.py. TPU-first addition: opt-in
+``pad_to_bucket`` pads every flushed batch up to the next power-of-two
+bucket so the wrapped JAX callable sees a small fixed set of shapes and
+never recompiles per batch size (SURVEY.md §7 "fixed shapes" hard part).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, List, Optional
+
+
+def next_bucket(n: int, max_size: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_size)
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float,
+                 pad_to_bucket: bool):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.pad_to_bucket = pad_to_bucket
+        self._lock = threading.Lock()
+        self._queue: List[dict] = []
+        self._flush_timer: Optional[threading.Timer] = None
+
+    def submit(self, item: Any, self_obj=None) -> Any:
+        entry = {"item": item, "event": threading.Event(),
+                 "result": None, "error": None}
+        do_flush = False
+        with self._lock:
+            self._queue.append(entry)
+            if len(self._queue) >= self.max_batch_size:
+                do_flush = True
+            elif self._flush_timer is None:
+                self._flush_timer = threading.Timer(
+                    self.batch_wait_timeout_s,
+                    lambda: self._flush(self_obj))
+                self._flush_timer.daemon = True
+                self._flush_timer.start()
+        if do_flush:
+            self._flush(self_obj)
+        entry["event"].wait()
+        if entry["error"] is not None:
+            raise entry["error"]
+        return entry["result"]
+
+    def _flush(self, self_obj=None):
+        with self._lock:
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+            # cap at max_batch_size: late enqueuers between the size check
+            # and this lock must not grow the batch past the bucket limit
+            batch = self._queue[:self.max_batch_size]
+            self._queue = self._queue[self.max_batch_size:]
+            if self._queue and self._flush_timer is None:
+                self._flush_timer = threading.Timer(
+                    self.batch_wait_timeout_s,
+                    lambda: self._flush(self_obj))
+                self._flush_timer.daemon = True
+                self._flush_timer.start()
+        if not batch:
+            return
+        items = [e["item"] for e in batch]
+        n = len(items)
+        if self.pad_to_bucket and n > 1:
+            target = next_bucket(n, self.max_batch_size)
+            items = items + [items[-1]] * (target - n)
+        try:
+            if self_obj is not None:
+                results = self.fn(self_obj, items)
+            else:
+                results = self.fn(items)
+            results = list(results)[:n]
+            for e, r in zip(batch, results):
+                e["result"] = r
+        except Exception as err:
+            for e in batch:
+                e["error"] = err
+        for e in batch:
+            e["event"].set()
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01,
+          pad_to_bucket: bool = False):
+    """Decorate ``fn(list_of_items) -> list_of_results`` (function or
+    method); concurrent single-item calls are transparently batched."""
+
+    def wrap(fn):
+        attr = f"__serve_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def method_wrapper(self, item):
+            # one batcher PER INSTANCE: a decoration-time batcher would
+            # mix items from different instances into one flush
+            batcher = getattr(self, attr, None)
+            if batcher is None:
+                batcher = _Batcher(fn, max_batch_size,
+                                   batch_wait_timeout_s, pad_to_bucket)
+                try:
+                    setattr(self, attr, batcher)
+                except AttributeError:  # __slots__ etc.
+                    pass
+            return batcher.submit(item, self_obj=self)
+
+        shared = _Batcher(fn, max_batch_size, batch_wait_timeout_s,
+                          pad_to_bucket)
+
+        @functools.wraps(fn)
+        def fn_wrapper(item):
+            return shared.submit(item)
+
+        # heuristically pick method vs free-function form
+        import inspect
+        params = list(inspect.signature(fn).parameters)
+        wrapper = (method_wrapper if params and params[0] == "self"
+                   else fn_wrapper)
+        wrapper._batcher = shared
+        return wrapper
+
+    return wrap if _fn is None else wrap(_fn)

@@ -1,0 +1,254 @@
+"""ServeController — the Serve control plane actor.
+
+Reference analogue: serve/controller.py:61 (run_control_loop:239,
+deploy_app:415) + _private/deployment_state.py (DeploymentState:958,
+scaling :1281, rolling updates keyed by version hash). One actor holds
+target state, reconciles replica actors toward it in a background
+thread, health-checks them, autoscales from queue metrics, and publishes
+the route table over long-poll.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentInfo:
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.version = hashlib.sha1(
+            repr(sorted((k, repr(v)) for k, v in config.items()
+                        if k != "num_replicas")).encode()).hexdigest()[:12]
+        self.target_replicas = config.get("num_replicas", 1)
+        # actor handle -> version string
+        self.replicas: Dict[Any, str] = {}
+        self.autoscaler = None
+        autoscale = config.get("autoscaling_config")
+        if autoscale:
+            from ray_tpu.serve._private.autoscaling import (
+                AutoscalingConfig, AutoscalingPolicy)
+            cfg = (autoscale if isinstance(autoscale, AutoscalingConfig)
+                   else AutoscalingConfig(**autoscale))
+            self.target_replicas = cfg.min_replicas
+            self.autoscaler = AutoscalingPolicy(cfg)
+
+
+class ServeController:
+    """Runs as a named detached actor with a high-concurrency thread
+    pool (long-poll listeners block in ``listen_for_change``)."""
+
+    def __init__(self, http_port: Optional[int] = None):
+        from ray_tpu.serve._private.long_poll import LongPollHost
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._lock = threading.RLock()
+        self._long_poll = LongPollHost()
+        self._replica_seq = 0
+        self._shutdown = threading.Event()
+        self._http_port = http_port
+        self._last_error: Optional[str] = None
+        self._reconcile_thread = threading.Thread(
+            target=self._control_loop, daemon=True)
+        self._reconcile_thread.start()
+
+    # ---- API called by serve.run / handles ----
+
+    def deploy_application(self, deployments: List[Dict[str, Any]]):
+        """Set target state; reconciliation happens asynchronously."""
+        with self._lock:
+            new_names = {d["name"] for d in deployments}
+            for d in deployments:
+                existing = self._deployments.get(d["name"])
+                info = _DeploymentInfo(d)
+                if existing is not None:
+                    info.replicas = existing.replicas
+                self._deployments[d["name"]] = info
+            for stale in set(self._deployments) - new_names:
+                self._deployments[stale].target_replicas = 0
+                self._deployments[stale].config["_deleted"] = True
+        self._reconcile_once()
+        return "ok"
+
+    def delete_deployments(self, names: List[str]):
+        with self._lock:
+            for n in names:
+                if n in self._deployments:
+                    self._deployments[n].target_replicas = 0
+                    self._deployments[n].config["_deleted"] = True
+        return "ok"
+
+    def listen_for_change(self, key: str, last_version: int):
+        return self._long_poll.listen(key, last_version)
+
+    def get_route_table(self):
+        return self._long_poll.get("route_table")
+
+    def get_deployment_statuses(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for name, info in self._deployments.items():
+                if info.config.get("_deleted"):
+                    continue
+                n_live = len(info.replicas)
+                out[name] = {
+                    "name": name,
+                    "status": ("HEALTHY"
+                               if n_live >= info.target_replicas
+                               else "UPDATING"),
+                    "target_replicas": info.target_replicas,
+                    "live_replicas": n_live,
+                    "version": info.version,
+                }
+                if self._last_error:
+                    out[name]["last_controller_error"] = self._last_error
+            return out
+
+    def get_http_port(self):
+        return self._http_port
+
+    def shutdown(self):
+        self._shutdown.set()
+        with self._lock:
+            for info in self._deployments.values():
+                info.target_replicas = 0
+        self._reconcile_once()
+        return "ok"
+
+    def ping(self):
+        return "pong"
+
+    # ---- reconciliation ----
+
+    def _control_loop(self):
+        import traceback
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+                self._autoscale_tick()
+                self._health_check()
+                self._last_error = None
+            except Exception:
+                # keep reconciling, but surface the failure in statuses
+                self._last_error = traceback.format_exc(limit=8)
+            self._shutdown.wait(1.0)
+
+    def _start_replica(self, name: str, info: _DeploymentInfo):
+        import ray_tpu
+        from ray_tpu.serve._private.replica import ReplicaActor
+        cfg = info.config
+        self._replica_seq += 1
+        opts = dict(
+            name=f"SERVE_REPLICA::{name}#{self._replica_seq}",
+            max_concurrency=cfg.get("max_concurrent_queries", 100),
+            lifetime="detached",
+        )
+        if cfg.get("ray_actor_options"):
+            opts.update(cfg["ray_actor_options"])
+        actor_cls = ray_tpu.remote(**opts)(ReplicaActor)
+        h = actor_cls.remote(
+            name, cfg["serialized_callable"],
+            tuple(cfg.get("init_args") or ()),
+            dict(cfg.get("init_kwargs") or {}),
+            user_config=cfg.get("user_config"),
+            version=info.version)
+        info.replicas[h] = info.version
+
+    def _stop_replica(self, handle):
+        import ray_tpu
+        try:
+            # wait (bounded) for the graceful hook BEFORE killing, else
+            # the kill races ahead of the fire-and-forget RPC
+            ray_tpu.get(handle.prepare_for_shutdown.remote(),
+                        timeout=5.0)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _reconcile_once(self):
+        import ray_tpu
+        changed = False
+        with self._lock:
+            for name, info in list(self._deployments.items()):
+                # rolling update: replace replicas on an old version
+                stale = [h for h, v in info.replicas.items()
+                         if v != info.version]
+                for h in stale:
+                    self._stop_replica(h)
+                    del info.replicas[h]
+                    changed = True
+                delta = info.target_replicas - len(info.replicas)
+                for _ in range(max(0, delta)):
+                    self._start_replica(name, info)
+                    changed = True
+                for _ in range(max(0, -delta)):
+                    h = next(iter(info.replicas))
+                    self._stop_replica(h)
+                    del info.replicas[h]
+                    changed = True
+                if info.config.get("_deleted") and not info.replicas:
+                    del self._deployments[name]
+                    changed = True
+        if changed:
+            self._publish_route_table()
+
+    def _publish_route_table(self):
+        with self._lock:
+            table = {}
+            for name, info in self._deployments.items():
+                if info.config.get("_deleted"):
+                    continue
+                table[name] = {
+                    "replicas": [h._id_hex
+                                 for h in info.replicas],
+                    "max_concurrent_queries":
+                        info.config.get("max_concurrent_queries", 100),
+                    "route_prefix": info.config.get("route_prefix"),
+                }
+        self._long_poll.notify_changed("route_table", table)
+
+    def _health_check(self):
+        import ray_tpu
+        with self._lock:
+            items = [(name, info, list(info.replicas))
+                     for name, info in self._deployments.items()]
+        dead = []
+        for name, info, handles in items:
+            for h in handles:
+                try:
+                    ray_tpu.get(h.check_health.remote(), timeout=10.0)
+                except Exception:
+                    dead.append((info, h))
+        if dead:
+            with self._lock:
+                for info, h in dead:
+                    info.replicas.pop(h, None)
+            self._reconcile_once()
+
+    def _autoscale_tick(self):
+        import ray_tpu
+        now = time.time()
+        with self._lock:
+            items = [(name, info, list(info.replicas))
+                     for name, info in self._deployments.items()
+                     if info.autoscaler is not None
+                     and not info.config.get("_deleted")]
+        for name, info, handles in items:
+            total = 0.0
+            for h in handles:
+                try:
+                    m = ray_tpu.get(h.get_metrics.remote(), timeout=5.0)
+                    total += m["num_ongoing_requests"]
+                except Exception:
+                    pass
+            decision = info.autoscaler.get_decision(
+                len(handles), total, now)
+            if decision != info.target_replicas:
+                with self._lock:
+                    info.target_replicas = decision

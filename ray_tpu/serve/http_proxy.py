@@ -1,0 +1,147 @@
+"""HTTP ingress — proxy actor routing HTTP requests to replicas.
+
+Reference analogue: serve/_private/http_proxy.py:387 (HTTPProxyActor,
+HTTPProxy.__call__:312 over uvicorn/ASGI). Here: a stdlib
+ThreadingHTTPServer inside an actor; each request thread routes through
+the shared backpressure-aware Router, so HTTP and handle traffic obey
+the same ``max_concurrent_queries`` flow control.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class HTTPProxyActor:
+    def __init__(self, controller_name: str, host: str = "127.0.0.1",
+                 port: int = 8000):
+        import ray_tpu
+        from ray_tpu.serve._private.long_poll import LongPollClient
+        from ray_tpu.serve._private.router import Router
+        self._controller = ray_tpu.get_actor(controller_name)
+        self._router = Router(self._controller)
+        self._routes: Dict[str, str] = {}   # route_prefix -> deployment
+        # routes update via long-poll, NOT a controller RPC per request
+        self._route_poller = LongPollClient(
+            self._controller, "route_table", self._on_route_update)
+        self.host, self.port = host, port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._refresh_routes()
+        self._start_server()
+
+    def _on_route_update(self, table):
+        routes = {}
+        for name, info in (table or {}).items():
+            prefix = info.get("route_prefix")
+            if prefix:
+                routes[prefix.rstrip("/") or "/"] = name
+        self._routes = routes
+
+    def _refresh_routes(self):
+        import ray_tpu
+        _, table = ray_tpu.get(
+            self._controller.get_route_table.remote())
+        self._on_route_update(table)
+
+    def _match(self, path: str) -> Optional[str]:
+        path = path.rstrip("/") or "/"
+        best, best_len = None, -1
+        for prefix, name in self._routes.items():
+            if (path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/")
+                    or prefix == "/"):
+                if len(prefix) > best_len:
+                    best, best_len = name, len(prefix)
+        return best
+
+    def _start_server(self):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self, body: Optional[bytes]):
+                import ray_tpu
+                parsed = urlparse(self.path)
+                name = proxy._match(parsed.path)
+                if name is None:
+                    # maybe deployed after our last long-poll tick
+                    proxy._refresh_routes()
+                    name = proxy._match(parsed.path)
+                if name is None:
+                    self._respond(404, {"error":
+                                        f"no route for {parsed.path}"})
+                    return
+                if body is not None and body:
+                    try:
+                        payload = json.loads(body)
+                    except Exception:
+                        payload = body.decode("utf-8", "replace")
+                else:
+                    q = parse_qs(parsed.query)
+                    payload = {k: v[0] if len(v) == 1 else v
+                               for k, v in q.items()} if q else None
+                try:
+                    ref, release = proxy._router.assign_request(
+                        name, "__call__",
+                        (payload,) if payload is not None else (), {})
+                    try:
+                        result = ray_tpu.get(ref, timeout=60.0)
+                    finally:
+                        release()
+                    self._respond(200, result)
+                except Exception as e:
+                    self._respond(500, {"error": repr(e)})
+
+            def _respond(self, code: int, result: Any):
+                try:
+                    data = json.dumps(result).encode()
+                    ctype = "application/json"
+                except (TypeError, ValueError):
+                    data = str(result).encode()
+                    ctype = "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._handle(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self._handle(self.rfile.read(n) if n else b"")
+
+        for attempt in range(32):
+            try:
+                self._server = ThreadingHTTPServer(
+                    (self.host, self.port + attempt), Handler)
+                self.port = self.port + attempt
+                break
+            except OSError:
+                continue
+        if self._server is None:
+            raise RuntimeError("no free port for HTTP proxy")
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def get_port(self) -> int:
+        return self.port
+
+    def ping(self):
+        return "pong"
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+        self._route_poller.stop()
+        self._router.stop()
+        return "ok"
