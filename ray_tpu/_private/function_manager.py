@@ -10,10 +10,62 @@ per-process.
 from __future__ import annotations
 
 import hashlib
+import os
+import sys
+import sysconfig
 import threading
 from typing import Any, Dict
 
 import cloudpickle
+
+_STDLIB = sysconfig.get_paths().get("stdlib", "")
+_SITE = sysconfig.get_paths().get("purelib", "")
+_by_value_registered: set = set()
+
+
+def _ensure_serializable_by_value(obj: Any, _depth: int = 0):
+    """Functions/classes from user script modules (not site-packages or
+    the framework itself) are pickled BY VALUE, so workers that can't
+    import the driver's script still execute them (the reference ships
+    the working_dir runtime env instead; by-value is the zero-install
+    equivalent for single-file drivers). Closure cells and defaults are
+    walked so captured user functions get the same treatment."""
+    if _depth > 2:
+        return
+    closure = getattr(obj, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if callable(v) or isinstance(v, type):
+                _ensure_serializable_by_value(v, _depth + 1)
+    for d in (getattr(obj, "__defaults__", None) or ()):
+        if callable(d) or isinstance(d, type):
+            _ensure_serializable_by_value(d, _depth + 1)
+    mod_name = getattr(obj, "__module__", None)
+    if not mod_name or mod_name in ("__main__", "builtins"):
+        return  # cloudpickle already handles __main__ by value
+    if mod_name in _by_value_registered:
+        return
+    if mod_name == "ray_tpu" or mod_name.startswith("ray_tpu."):
+        return
+    mod = sys.modules.get(mod_name)
+    mod_file = getattr(mod, "__file__", None)
+    if mod is None or not mod_file:
+        return
+    mod_file = os.path.abspath(mod_file)
+    # installed packages (any site-packages/dist-packages, incl. --user
+    # installs) and the stdlib are importable on workers → by reference
+    if ("site-packages" in mod_file or "dist-packages" in mod_file
+            or (_STDLIB and mod_file.startswith(_STDLIB + os.sep))):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+        _by_value_registered.add(mod_name)
+    except Exception:
+        pass
 
 
 class FunctionManager:
@@ -25,7 +77,21 @@ class FunctionManager:
         self._lock = threading.Lock()
 
     def export(self, obj: Any, kind: str = "fn") -> str:
-        blob = cloudpickle.dumps(obj, protocol=5)
+        _ensure_serializable_by_value(obj)
+        try:
+            blob = cloudpickle.dumps(obj, protocol=5)
+        except Exception:
+            # a module registered by value may hold unpicklable state;
+            # fall back to by-reference for everything we registered
+            for m in list(_by_value_registered):
+                mod = sys.modules.get(m)
+                if mod is not None:
+                    try:
+                        cloudpickle.unregister_pickle_by_value(mod)
+                    except Exception:
+                        pass
+                _by_value_registered.discard(m)
+            blob = cloudpickle.dumps(obj, protocol=5)
         key = f"{kind}:{hashlib.sha1(blob).hexdigest()}"
         with self._lock:
             if key in self._exported:

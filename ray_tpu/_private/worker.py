@@ -669,7 +669,9 @@ class Worker:
         while len(ready) < num_returns:
             still = []
             for ref in pending:
-                if self._is_ready(ref):
+                # cap at num_returns (reference ray.wait semantics):
+                # extras stay pending for the next call
+                if len(ready) < num_returns and self._is_ready(ref):
                     ready.append(ref)
                 else:
                     still.append(ref)

@@ -1,0 +1,12 @@
+"""Lazy task/actor DAG authoring — .bind()/.execute().
+
+Reference analogue: python/ray/dag (DAGNode dag_node.py:339,
+FunctionNode/ClassNode/InputNode). DAGs built here are the substrate
+the workflow engine executes durably.
+"""
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
+                                  FunctionNode, InputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode"]

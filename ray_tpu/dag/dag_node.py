@@ -1,0 +1,130 @@
+"""DAG node types.
+
+Reference analogue: python/ray/dag/dag_node.py, function_node.py,
+class_node.py, input_node.py. ``fn.bind(x)`` builds a lazy node;
+``node.execute(input)`` walks the DAG, submitting tasks/actor calls and
+wiring ObjectRefs as dependencies (the scheduler overlaps anything
+independent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """Base lazy node. Subclasses implement ``_execute_impl``."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal --
+
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(
+                self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _resolve_args(self, cache: Dict[int, Any], input_value: Any
+                      ) -> Tuple[tuple, dict]:
+        def res(v):
+            if isinstance(v, DAGNode):
+                return v._execute_cached(cache, input_value)
+            return v
+        args = tuple(res(a) for a in self._bound_args)
+        kwargs = {k: res(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_cached(self, cache: Dict[int, Any], input_value: Any):
+        key = id(self)
+        if key not in cache:
+            cache[key] = self._execute_impl(cache, input_value)
+        return cache[key]
+
+    def _execute_impl(self, cache, input_value):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Run the DAG rooted here; returns an ObjectRef (or value for
+        InputNode roots)."""
+        return self._execute_cached({}, input_value)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input (reference: input_node.py:343).
+    Usable as a context manager for parity with the reference API."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def _execute_impl(self, cache, input_value):
+        return input_value
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    """Lazy invocation of a remote function."""
+
+    def __init__(self, remote_fn, args, kwargs, opts=None):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+        self._opts = opts or {}
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        return self._remote_fn._remote(args, kwargs, self._opts)
+
+
+class ClassNode(DAGNode):
+    """Lazy actor instantiation; attribute access yields method nodes."""
+
+    def __init__(self, actor_cls, args, kwargs, opts=None):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._opts = opts or {}
+
+    def _execute_impl(self, cache, input_value):
+        args, kwargs = self._resolve_args(cache, input_value)
+        return self._actor_cls._create(self._opts, args, kwargs)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodStub(self, name)
+
+
+class _ClassMethodStub:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name,
+                               args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """Lazy method call on a ClassNode-created actor."""
+
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def _children(self):
+        return super()._children() + [self._class_node]
+
+    def _execute_impl(self, cache, input_value):
+        actor = self._class_node._execute_cached(cache, input_value)
+        args, kwargs = self._resolve_args(cache, input_value)
+        return getattr(actor, self._method_name).remote(*args, **kwargs)

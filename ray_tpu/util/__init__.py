@@ -1,0 +1,15 @@
+"""ray_tpu.util — user utilities (reference: python/ray/util/)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "ActorPool", "Queue", "Empty", "Full", "placement_group",
+    "PlacementGroup", "remove_placement_group", "placement_group_table",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+]

@@ -1,0 +1,165 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Reference analogue: python/ray/util/metrics.py flowing into the C++
+stats pipeline (SURVEY.md §5.5). Here metrics aggregate in a named
+metrics-hub actor and export in Prometheus text format
+(``ray_tpu.util.metrics.prometheus_text()``), which the dashboard
+scrapes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import ray_tpu
+
+_HUB_NAME = "METRICS_HUB"
+_local_lock = threading.Lock()
+
+
+class _MetricsHub:
+    """Cluster-wide aggregation point (one named actor)."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, tuple], dict] = {}
+        self._lock = threading.Lock()
+
+    def record(self, name: str, kind: str, value: float,
+               tags: Optional[Dict[str, str]], description: str,
+               boundaries: Optional[List[float]] = None):
+        key = (name, tuple(sorted((tags or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = {"name": name, "kind": kind, "tags": tags or {},
+                     "description": description, "value": 0.0,
+                     "count": 0, "sum": 0.0,
+                     "boundaries": boundaries or [],
+                     "buckets": [0] * (len(boundaries or []) + 1)}
+                self._metrics[key] = m
+            if kind == "counter":
+                m["value"] += value
+            elif kind == "gauge":
+                m["value"] = value
+            else:  # histogram
+                m["count"] += 1
+                m["sum"] += value
+                for i, b in enumerate(m["boundaries"]):
+                    if value <= b:
+                        m["buckets"][i] += 1
+                        break
+                else:
+                    m["buckets"][-1] += 1
+
+    def dump(self) -> List[dict]:
+        with self._lock:
+            return [dict(m) for m in self._metrics.values()]
+
+
+def _hub():
+    try:
+        return ray_tpu.get_actor(_HUB_NAME)
+    except Exception:
+        pass
+    with _local_lock:
+        try:
+            return ray_tpu.get_actor(_HUB_NAME)
+        except Exception:
+            cls = ray_tpu.remote(name=_HUB_NAME, lifetime="detached",
+                                 max_concurrency=8)(_MetricsHub)
+            try:
+                return cls.remote()
+            except Exception:
+                return ray_tpu.get_actor(_HUB_NAME)
+
+
+class _Metric:
+    KIND = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._boundaries: Optional[List[float]] = None
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]]):
+        merged = {**self._default_tags, **(tags or {})}
+        # fire-and-forget to the hub
+        _hub().record.remote(self._name, self.KIND, float(value),
+                             merged, self._description,
+                             self._boundaries)
+
+
+class Counter(_Metric):
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags)
+
+
+class Gauge(_Metric):
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags)
+
+
+class Histogram(_Metric):
+    KIND = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = sorted(boundaries or [1.0])
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags)
+
+
+def dump_metrics() -> List[dict]:
+    return ray_tpu.get(_hub().dump.remote(), timeout=30.0)
+
+
+def _escape_label(v: str) -> str:
+    """Prometheus exposition format requires \\\\, \\\" and newline
+    escapes in label values."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format of every recorded metric."""
+    lines = []
+    for m in dump_metrics():
+        tag_str = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in sorted(m["tags"].items()))
+        tag_part = f"{{{tag_str}}}" if tag_str else ""
+        if m["kind"] in ("counter", "gauge"):
+            lines.append(f"# TYPE {m['name']} {m['kind']}")
+            lines.append(f"{m['name']}{tag_part} {m['value']}")
+        else:
+            lines.append(f"# TYPE {m['name']} histogram")
+            acc = 0
+            for b, c in zip(m["boundaries"], m["buckets"]):
+                acc += c
+                sep = "," if tag_str else ""
+                lines.append(
+                    f'{m["name"]}_bucket{{{tag_str}{sep}le="{b}"}} {acc}')
+            sep = "," if tag_str else ""
+            lines.append(
+                f'{m["name"]}_bucket{{{tag_str}{sep}le="+Inf"}} '
+                f'{m["count"]}')
+            lines.append(f"{m['name']}_sum{tag_part} {m['sum']}")
+            lines.append(f"{m['name']}_count{tag_part} {m['count']}")
+    return "\n".join(lines) + "\n"

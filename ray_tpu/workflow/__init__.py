@@ -1,0 +1,7 @@
+"""ray_tpu.workflow — durable DAG execution (reference: workflow/)."""
+
+from ray_tpu.workflow.api import (get_output, get_status, list_workflows,
+                                  resume, run, run_async, set_storage)
+
+__all__ = ["run", "run_async", "resume", "get_status", "get_output",
+           "list_workflows", "set_storage"]

@@ -1,0 +1,197 @@
+"""Workflow engine: durable DAG execution with resume.
+
+Reference analogue: workflow/api.py (run:120, run_async:166),
+workflow_executor.py, workflow_access.py. A DAG authored with
+``.bind()`` (ray_tpu.dag) executes step-by-step; every step's result is
+persisted before dependents run, so a crashed workflow resumes from the
+last completed step (exactly-once per step, assuming idempotent steps —
+same contract as the reference).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow.storage import (WorkflowStorage, list_workflows,
+                                      set_storage)
+
+
+def _arg_digest(a: Any) -> str:
+    """Process-stable digest of a static argument. Pickle bytes (unlike
+    repr) don't embed memory addresses, so resume in a new process
+    computes identical step ids."""
+    try:
+        return hashlib.sha1(cloudpickle.dumps(a)).hexdigest()[:16]
+    except Exception:
+        return repr(a)
+
+
+def _step_id(node: DAGNode, child_ids: List[str]) -> str:
+    """Deterministic content-based step id: function name + static
+    args/kwargs digests + child step ids, so resume matches steps
+    across processes."""
+    if isinstance(node, FunctionNode):
+        fn = node._remote_fn._fn
+        base = f"{fn.__module__}.{fn.__qualname__}"
+    else:
+        base = type(node).__name__
+    static_args = [_arg_digest(a) for a in node._bound_args
+                   if not isinstance(a, DAGNode)]
+    static_kwargs = [f"{k}={_arg_digest(v)}"
+                     for k, v in sorted(node._bound_kwargs.items())
+                     if not isinstance(v, DAGNode)]
+    payload = "|".join([base, *static_args, *static_kwargs, *child_ids])
+    return (base.split(".")[-1] + "-"
+            + hashlib.sha1(payload.encode()).hexdigest()[:10])
+
+
+class _StepExec:
+    """Recursive executor materializing one step at a time (children
+    first), checkpointing each result."""
+
+    def __init__(self, storage: WorkflowStorage, input_value: Any):
+        self.storage = storage
+        self.input_value = input_value
+        self._memo: Dict[int, Any] = {}
+
+    def run(self, node: Any) -> Any:
+        if not isinstance(node, DAGNode):
+            return node
+        key = id(node)
+        if key in self._memo:
+            return self._memo[key]
+        if isinstance(node, InputNode):
+            value = self.input_value
+            self._memo[key] = value
+            return value
+        child_ids: List[str] = []
+        resolved_args = []
+        for a in node._bound_args:
+            if isinstance(a, DAGNode):
+                v, cid = self._run_child(a)
+                resolved_args.append(v)
+                child_ids.append(cid)
+            else:
+                resolved_args.append(a)
+        resolved_kwargs = {}
+        for k, a in node._bound_kwargs.items():
+            if isinstance(a, DAGNode):
+                v, cid = self._run_child(a)
+                resolved_kwargs[k] = v
+                child_ids.append(cid)
+            else:
+                resolved_kwargs[k] = a
+        sid = _step_id(node, child_ids)
+        if self.storage.has_step_result(sid):
+            value = self.storage.load_step_result(sid)
+        else:
+            if isinstance(node, FunctionNode):
+                ref = node._remote_fn._remote(
+                    tuple(resolved_args), resolved_kwargs, node._opts)
+                value = ray_tpu.get(ref)
+            else:
+                raise TypeError(
+                    f"workflows support function DAG nodes, got "
+                    f"{type(node).__name__} (actor nodes are not "
+                    f"durable)")
+            self.storage.save_step_result(sid, value)
+        self._memo[key] = value
+        return value
+
+    def _run_child(self, node: DAGNode):
+        if not hasattr(self, "_fp_cache"):
+            self._fp_cache = {}
+        return self.run(node), _node_fingerprint(node, self._fp_cache)
+
+
+def _node_fingerprint(node: DAGNode, _memo: Optional[Dict[int, str]] = None
+                      ) -> str:
+    # memoized by node identity: diamond DAGs would otherwise cost
+    # exponential re-hashing of shared subgraphs
+    _memo = _memo if _memo is not None else {}
+    key = id(node)
+    if key in _memo:
+        return _memo[key]
+    child_ids = [_node_fingerprint(c, _memo) for c in node._children()]
+    fp = "input" if isinstance(node, InputNode) else _step_id(
+        node, child_ids)
+    _memo[key] = fp
+    return fp
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Execute a workflow to completion; resumable by workflow_id
+    (reference: workflow.run api.py:120)."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_status("RUNNING")
+    try:
+        storage.save_dag(cloudpickle.dumps((dag, input_value)))
+    except Exception:
+        pass  # non-picklable DAGs can still run, just not resume cold
+    try:
+        result = _StepExec(storage, input_value).run(dag)
+        storage.save_step_result("__result__", result)
+        storage.save_status("SUCCESSFUL")
+        return result
+    except Exception as e:
+        storage.save_status("FAILED", {"error": repr(e)})
+        raise
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Run in a background task; returns an ObjectRef of the result."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    blob = cloudpickle.dumps((dag, input_value))
+    from ray_tpu.workflow.storage import get_storage
+    storage_root = get_storage()
+
+    @ray_tpu.remote
+    def _driver(blob, wid, root):
+        import cloudpickle as cp
+        from ray_tpu.workflow import api as wf_api
+        from ray_tpu.workflow.storage import set_storage as _set
+        # the worker process has its own module global; without this the
+        # workflow persists to the default root and the driver's
+        # get_status/resume can't find it
+        _set(root)
+        d, iv = cp.loads(blob)
+        return wf_api.run(d, workflow_id=wid, input_value=iv)
+
+    return _driver.remote(blob, workflow_id, storage_root)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a workflow from storage; completed steps are skipped."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_step_result("__result__"):
+        return storage.load_step_result("__result__")
+    blob = storage.load_dag()
+    if blob is None:
+        raise ValueError(f"workflow {workflow_id!r} has no persisted DAG")
+    dag, input_value = cloudpickle.loads(blob)
+    return run(dag, workflow_id=workflow_id, input_value=input_value)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    st = WorkflowStorage(workflow_id).load_status()
+    return st["status"] if st else None
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_step_result("__result__"):
+        raise ValueError(f"workflow {workflow_id!r} has no output yet")
+    return storage.load_step_result("__result__")
+
+
+__all__ = ["run", "run_async", "resume", "get_status", "get_output",
+           "list_workflows", "set_storage"]

@@ -1,0 +1,92 @@
+"""Workflow persistence.
+
+Reference analogue: workflow/workflow_storage.py (every step result
+persisted on ``ray.storage`` for exactly-once resume). Layout:
+``<root>/<workflow_id>/steps/<step_id>.pkl`` + ``status.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_ROOT = os.path.join(tempfile.gettempdir(), "ray_tpu_workflows")
+_storage_root = os.environ.get("RTPU_WORKFLOW_STORAGE", _DEFAULT_ROOT)
+
+
+def set_storage(root: str):
+    global _storage_root
+    _storage_root = root
+
+
+def get_storage() -> str:
+    return _storage_root
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str,
+                 root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(root or _storage_root, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    # atomic write: temp file + rename
+    def _write(self, path: str, data: bytes):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def save_step_result(self, step_id: str, value: Any):
+        self._write(os.path.join(self.dir, "steps", f"{step_id}.pkl"),
+                    pickle.dumps(value))
+
+    def has_step_result(self, step_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self.dir, "steps", f"{step_id}.pkl"))
+
+    def load_step_result(self, step_id: str) -> Any:
+        with open(os.path.join(self.dir, "steps", f"{step_id}.pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def save_status(self, status: str,
+                    extra: Optional[Dict[str, Any]] = None):
+        doc = {"workflow_id": self.workflow_id, "status": status,
+               **(extra or {})}
+        self._write(os.path.join(self.dir, "status.json"),
+                    json.dumps(doc).encode())
+
+    def load_status(self) -> Optional[Dict[str, Any]]:
+        p = os.path.join(self.dir, "status.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def save_dag(self, dag_bytes: bytes):
+        self._write(os.path.join(self.dir, "dag.pkl"), dag_bytes)
+
+    def load_dag(self) -> Optional[bytes]:
+        p = os.path.join(self.dir, "dag.pkl")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+
+def list_workflows(root: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = root or _storage_root
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wid in sorted(os.listdir(root)):
+        st = WorkflowStorage(wid, root).load_status()
+        if st:
+            out.append(st)
+    return out
