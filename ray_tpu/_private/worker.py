@@ -386,6 +386,7 @@ class Worker:
         self._server = protocol.Server(self._handlers())
         self.io.run(self._server.start_unix(sock))
         self.address = f"unix:{sock}"
+        self.gcs_address = gcs_address
         self.gcs = self.io.run(protocol.connect(
             gcs_address, handler=self._handle_request))
         self.plasma = PlasmaxStore(store_path)
@@ -969,6 +970,8 @@ class Worker:
         owner = spec["owner_address"]
         returns = []
         app_error = False
+        from ray_tpu.util import timeline as _timeline
+        _t0 = time.time()
         try:
             if task_hex in self._cancelled_tasks:
                 raise exc.TaskCancelledError(task_hex)
@@ -1005,6 +1008,9 @@ class Worker:
                                 "inline": ser.to_bytes()})
         finally:
             self.current_task_id = None
+            _timeline.record_task(spec.get("fn_name", "task"), _t0,
+                                  time.time(), pid=os.getpid(),
+                                  failed=app_error)
         self.try_notify(owner, "task_result", {
             "task_id": task_hex, "returns": returns, "app_error": app_error})
         if self.raylet is not None:

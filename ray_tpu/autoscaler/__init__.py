@@ -1,0 +1,12 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling
+(reference: autoscaler/_private/autoscaler.py + node providers)."""
+
+from ray_tpu.autoscaler.autoscaler import (AutoscalerMonitor,
+                                           LoadMetrics,
+                                           StandardAutoscaler,
+                                           request_resources)
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              NodeProvider)
+
+__all__ = ["StandardAutoscaler", "AutoscalerMonitor", "LoadMetrics",
+           "request_resources", "NodeProvider", "FakeMultiNodeProvider"]

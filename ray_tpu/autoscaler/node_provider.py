@@ -1,0 +1,88 @@
+"""NodeProvider plugin interface + fake provider for tests.
+
+Reference analogue: autoscaler/node_provider.py (ABC) and
+autoscaler/_private/fake_multi_node/node_provider.py:237
+(FakeMultiNodeProvider — full autoscaler logic with no cloud: worker
+"nodes" are extra raylet processes on this machine sharing the head's
+GCS, exactly like the Cluster test fixture).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Cloud-agnostic node lifecycle interface."""
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        self.provider_config = provider_config
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict[str, Any],
+                    count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str):
+        raise NotImplementedError
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches worker raylets in-process against the running head."""
+
+    def __init__(self, provider_config: Dict[str, Any]):
+        super().__init__(provider_config)
+        from ray_tpu._private import node as node_mod
+        self._node_mod = node_mod
+        self.session_dir = provider_config["session_dir"]
+        self.gcs_address = provider_config["gcs_address"]
+        self._nodes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def create_node(self, node_config: Dict[str, Any],
+                    count: int) -> List[str]:
+        created = []
+        for _ in range(count):
+            info = self._node_mod.add_node(
+                self.session_dir, self.gcs_address,
+                resources=dict(node_config.get("resources")
+                               or {"CPU": 1}),
+                object_store_memory=node_config.get(
+                    "object_store_memory"))
+            nid = info["node_id"]
+            with self._lock:
+                self._nodes[nid] = info
+            created.append(nid)
+        return created
+
+    def terminate_node(self, node_id: str):
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        proc = info.get("proc")
+        if proc is not None:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        with self._lock:
+            info = self._nodes.get(node_id) or {}
+        return dict(info.get("resources") or {})

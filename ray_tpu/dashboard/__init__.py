@@ -1,0 +1,7 @@
+"""ray_tpu.dashboard — HTTP observability + job REST
+(reference: dashboard/)."""
+
+from ray_tpu.dashboard.dashboard import (DASHBOARD_NAME, DashboardActor,
+                                         start_dashboard)
+
+__all__ = ["start_dashboard", "DashboardActor", "DASHBOARD_NAME"]

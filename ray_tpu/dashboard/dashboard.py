@@ -1,0 +1,159 @@
+"""Dashboard — HTTP observability + job REST API.
+
+Reference analogue: dashboard/dashboard.py + head.py (aiohttp module
+registry) and modules/{node,actor,job,metrics,healthz}. Endpoints:
+
+  GET  /api/cluster_status   resources + node/actor summary
+  GET  /api/nodes            node table
+  GET  /api/actors           actor table
+  GET  /api/jobs/            job list      POST /api/jobs/  submit
+  GET  /api/jobs/<id>        job info      GET /api/jobs/<id>/logs
+  POST /api/jobs/<id>/stop
+  GET  /metrics              Prometheus exposition (util.metrics hub)
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+
+class DashboardActor:
+    """Runs the HTTP server inside a detached actor (like the Serve
+    proxy), so `ray-tpu start --head` and tests manage it uniformly."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host, self.port = host, port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._start_server()
+
+    def _start_server(self):
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, doc: Any):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _text(self, code: int, text: str,
+                      ctype: str = "text/plain"):
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    self._route("GET", None)
+                except Exception as e:
+                    self._json(500, {"error": repr(e)})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}") \
+                        if n else {}
+                except Exception as e:
+                    return self._json(400, {"error": f"bad body: {e!r}"})
+                try:
+                    self._route("POST", body)
+                except Exception as e:
+                    self._json(500, {"error": repr(e)})
+
+            def _route(self, method: str, body):
+                from ray_tpu.experimental.state import api as state
+                from ray_tpu.job_submission import JobSubmissionClient
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    return self._text(200, "ok")
+                if path == "/metrics":
+                    from ray_tpu.util import metrics
+                    try:
+                        return self._text(200, metrics.prometheus_text())
+                    except Exception:
+                        return self._text(200, "")
+                if path == "/api/cluster_status":
+                    return self._json(200, state.summarize_cluster())
+                if path == "/api/nodes":
+                    return self._json(200, {"nodes": state.list_nodes()})
+                if path == "/api/actors":
+                    return self._json(200,
+                                      {"actors": state.list_actors()})
+                client = JobSubmissionClient()
+                if path in ("/api/jobs", "/api/jobs/"):
+                    if method == "POST":
+                        job_id = client.submit_job(
+                            entrypoint=body["entrypoint"],
+                            job_id=body.get("job_id"),
+                            runtime_env=body.get("runtime_env"),
+                            metadata=body.get("metadata"))
+                        return self._json(200, {"job_id": job_id})
+                    return self._json(200, {"jobs": client.list_jobs()})
+                m = re.match(r"^/api/jobs/([^/]+)(/logs|/stop)?$", path)
+                if m:
+                    job_id, sub = m.group(1), m.group(2)
+                    if sub == "/logs":
+                        return self._json(
+                            200, {"logs": client.get_job_logs(job_id)})
+                    if sub == "/stop":
+                        return self._json(
+                            200, {"stopped": client.stop_job(job_id)})
+                    info = client.get_job_info(job_id)
+                    info["status"] = client.get_job_status(job_id)
+                    return self._json(200, info)
+                return self._json(404, {"error": f"no route {path}"})
+
+        for attempt in range(32):
+            try:
+                self._server = ThreadingHTTPServer(
+                    (self.host, self.port + attempt), Handler)
+                self.port = self.port + attempt
+                break
+            except OSError:
+                continue
+        if self._server is None:
+            raise RuntimeError("no free port for dashboard")
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def get_port(self) -> int:
+        return self.port
+
+    def ping(self):
+        return "pong"
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+        return "ok"
+
+
+DASHBOARD_NAME = "DASHBOARD"
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:
+    """Start (or find) the dashboard actor; returns the bound port."""
+    import ray_tpu
+    try:
+        d = ray_tpu.get_actor(DASHBOARD_NAME)
+        return ray_tpu.get(d.get_port.remote(), timeout=10.0)
+    except Exception:
+        pass
+    cls = ray_tpu.remote(name=DASHBOARD_NAME, lifetime="detached",
+                         max_concurrency=16)(DashboardActor)
+    d = cls.remote(host, port)
+    return ray_tpu.get(d.get_port.remote(), timeout=30.0)

@@ -1,0 +1,7 @@
+from ray_tpu.experimental.state.api import (list_actors, list_jobs,
+                                            list_nodes,
+                                            list_placement_groups,
+                                            summarize_cluster)
+
+__all__ = ["list_actors", "list_jobs", "list_nodes",
+           "list_placement_groups", "summarize_cluster"]

@@ -1,0 +1,62 @@
+"""Cluster state API — `list actors/nodes/jobs/placement groups`.
+
+Reference analogue: python/ray/experimental/state/api.py (+ the
+dashboard-side state_aggregator.py). Queries go straight to the GCS
+over the driver's existing connection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as _worker_mod
+
+
+def _gcs_call(method: str, payload: Optional[dict] = None) -> dict:
+    w = _worker_mod._global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu is not initialized")
+    return w.call_sync(w.gcs, method, payload or {}, timeout=30)
+
+
+def list_nodes(filters: Optional[Dict[str, Any]] = None
+               ) -> List[Dict[str, Any]]:
+    return _apply_filters(_gcs_call("get_nodes"), filters)
+
+
+def list_actors(filters: Optional[Dict[str, Any]] = None
+                ) -> List[Dict[str, Any]]:
+    return _apply_filters(_gcs_call("list_actors"), filters)
+
+
+def list_jobs(filters: Optional[Dict[str, Any]] = None
+              ) -> List[Dict[str, Any]]:
+    return _apply_filters(_gcs_call("get_jobs"), filters)
+
+
+def list_placement_groups(filters: Optional[Dict[str, Any]] = None
+                          ) -> List[Dict[str, Any]]:
+    return _apply_filters(_gcs_call("list_placement_groups"), filters)
+
+
+def summarize_cluster() -> Dict[str, Any]:
+    nodes = list_nodes()
+    actors = list_actors()
+    return {
+        "nodes_total": len(nodes),
+        "nodes_alive": sum(1 for n in nodes if n.get("alive")),
+        "actors_total": len(actors),
+        "actors_alive": sum(1 for a in actors
+                            if a.get("state") == "ALIVE"),
+        "cluster_resources": _gcs_call("cluster_resources"),
+        "available_resources": _gcs_call("available_resources"),
+    }
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    if not filters:
+        return rows
+    return [r for r in rows
+            if all(r.get(k) == v for k, v in filters.items())]

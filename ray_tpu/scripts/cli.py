@@ -1,0 +1,198 @@
+"""`ray-tpu` command line.
+
+Reference analogue: python/ray/scripts/scripts.py (`ray start/stop/
+status/memory/timeline`) + dashboard/modules/job/cli.py (`ray job ...`).
+argparse-based (zero extra deps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _connect(address=None):
+    import ray_tpu
+    ray_tpu.init(address=address or os.environ.get("RTPU_ADDRESS"),
+                 ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_start(args):
+    import ray_tpu
+    if args.head:
+        ctx = ray_tpu.init(
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            resources=json.loads(args.resources)
+            if args.resources else None)
+        print(f"started head; GCS at {ctx['gcs_address']}")
+        print(f"export RTPU_ADDRESS={ctx['gcs_address']}")
+        if args.dashboard:
+            from ray_tpu.dashboard.dashboard import start_dashboard
+            port = start_dashboard(port=args.dashboard_port)
+            print(f"dashboard at http://127.0.0.1:{port}")
+        if args.block:
+            print("blocking; Ctrl-C to stop")
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                pass
+            ray_tpu.shutdown()
+    else:
+        if not args.address:
+            sys.exit("--address required for worker nodes")
+        from ray_tpu._private import node as node_mod
+        info = node_mod.add_node(
+            node_mod.new_session_dir(), args.address,
+            resources={"CPU": args.num_cpus or 1,
+                       **({"TPU": args.num_tpus}
+                          if args.num_tpus else {})})
+        print(f"started worker node {info['node_id'][:8]} "
+              f"against {args.address}")
+        if args.block:
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                info["proc"].terminate()
+
+
+def cmd_stop(args):
+    # reference `ray stop`: kill every framework process on this machine
+    patterns = ["ray_tpu._private.gcs_main",
+                "ray_tpu._private.raylet_main",
+                "ray_tpu._private.default_worker"]
+    n = 0
+    for pat in patterns:
+        r = subprocess.run(["pkill", "-f", pat], capture_output=True)
+        n += int(r.returncode == 0)
+    print(f"stopped ({n} process groups signalled)")
+
+
+def cmd_status(args):
+    rt = _connect(args.address)
+    from ray_tpu.experimental.state import summarize_cluster
+    s = summarize_cluster()
+    print(json.dumps(s, indent=2, default=str))
+
+
+def cmd_memory(args):
+    rt = _connect(args.address)
+    w = rt._worker_mod.global_worker()
+    refs = w.reference_counter.debug_dump() if hasattr(
+        w.reference_counter, "debug_dump") else {}
+    print(json.dumps({"local_references": len(refs) if refs else 0},
+                     indent=2))
+
+
+def cmd_timeline(args):
+    rt = _connect(args.address)
+    from ray_tpu.util.timeline import timeline_dump
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(timeline_dump(), f)
+    print(f"wrote {out}")
+
+
+def cmd_job_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(args.address)
+    job_id = client.submit_job(
+        entrypoint=" ".join(args.entrypoint),
+        runtime_env=json.loads(args.runtime_env)
+        if args.runtime_env else None)
+    print(f"submitted job {job_id}")
+    if args.wait:
+        status = client.wait_until_finish(job_id, timeout=args.timeout)
+        print(f"job {job_id}: {status}")
+        print(client.get_job_logs(job_id))
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def cmd_job_status(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    print(JobSubmissionClient(args.address).get_job_status(args.job_id))
+
+
+def cmd_job_logs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    print(JobSubmissionClient(args.address).get_job_logs(args.job_id))
+
+
+def cmd_job_list(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    for j in JobSubmissionClient(args.address).list_jobs():
+        print(f"{j.get('job_id')}\t{j.get('status')}\t"
+              f"{j.get('entrypoint')}")
+
+
+def cmd_job_stop(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    JobSubmissionClient(args.address).stop_job(args.job_id)
+    print(f"stopped {args.job_id}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ray-tpu",
+        description="TPU-native distributed compute framework")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address for worker nodes")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", help="JSON dict of extra resources")
+    sp.add_argument("--dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(func=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop all local processes")
+    sp.set_defaults(func=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_status)
+
+    sp = sub.add_parser("memory", help="reference/memory summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_memory)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", default=None)
+    sp.set_defaults(func=cmd_timeline)
+
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_command", required=True)
+    sp = jsub.add_parser("submit")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--runtime-env", default=None)
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.add_argument("entrypoint", nargs="+")
+    sp.set_defaults(func=cmd_job_submit)
+    for name, fn in (("status", cmd_job_status), ("logs", cmd_job_logs),
+                     ("stop", cmd_job_stop)):
+        sp = jsub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        sp.add_argument("job_id")
+        sp.set_defaults(func=fn)
+    sp = jsub.add_parser("list")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_job_list)
+
+    args = p.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

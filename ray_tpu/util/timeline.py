@@ -1,11 +1,121 @@
-"""Minimal chrome-trace event collection (fleshed out with the state API)."""
-_events = []
+"""Chrome-trace timeline events.
+
+Reference analogue: the profile-event pipeline behind `ray timeline`
+(core_worker/profiling.cc → StatsGcsService.AddProfileData →
+_private/state.py:414 chrome_tracing_dump). Here each worker buffers
+task begin/end events locally and pushes them to the GCS KV; the driver
+merges all per-process buffers into one chrome://tracing JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_MAX_EVENTS = 10_000  # ring-buffer cap: bounds memory + kv payload
+_flusher_started = False
+
+
+def _ensure_flusher():
+    """Background flusher so events recorded just before a worker goes
+    idle still reach the GCS (flush-on-record alone would strand them
+    inside the min_interval window)."""
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(1.0)
+            try:
+                flush()
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, daemon=True).start()
 
 
 def record(name, ph, ts, pid=0, tid=0, **kw):
-    _events.append({"name": name, "ph": ph, "ts": ts, "pid": pid,
-                    "tid": tid, **kw})
+    with _lock:
+        _events.append({"name": name, "ph": ph, "ts": ts, "pid": pid,
+                        "tid": tid, **kw})
 
 
-def collect():
-    return list(_events)
+def record_task(name: str, t0: float, t1: float, pid: int = 0,
+                failed: bool = False):
+    """Complete ('X') event per task execution; flushed opportunistically
+    to the GCS so the driver can merge cross-process."""
+    with _lock:
+        _events.append({
+            "name": name, "ph": "X", "ts": t0 * 1e6,
+            "dur": (t1 - t0) * 1e6, "pid": pid,
+            "tid": threading.get_ident() % 1_000_000,
+            "cname": "terrible" if failed else None,
+            "cat": "task",
+        })
+        if len(_events) > _MAX_EVENTS:
+            del _events[:len(_events) - _MAX_EVENTS]
+    # async: the background flusher pushes to GCS so the task-completion
+    # path never blocks on a kv_put
+    _ensure_flusher()
+
+
+def collect() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+_last_pushed_len = 0
+
+
+def flush():
+    """Push this process's buffer to GCS KV under a per-pid key (no-op
+    when nothing new was recorded since the previous push)."""
+    global _last_pushed_len
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod._global_worker
+    if w is None or not w.connected:
+        return
+    with _lock:
+        if len(_events) == _last_pushed_len:
+            return
+        events = list(_events)
+        _last_pushed_len = len(events)
+    try:
+        w.call_sync(w.gcs, "kv_put", {
+            "key": f"@timeline/{w.node_id[:8]}-{os.getpid()}",
+            "value": json.dumps(events).encode(),
+            "overwrite": True}, timeout=5)
+    except Exception:
+        pass
+
+
+def timeline_dump() -> List[Dict[str, Any]]:
+    """Merge every process's events into one chrome-trace list
+    (driver-side; reference: `ray timeline`)."""
+    from ray_tpu._private import worker as worker_mod
+    flush()
+    w = worker_mod._global_worker
+    merged: List[Dict[str, Any]] = []
+    if w is not None and w.connected:
+        try:
+            keys = w.call_sync(w.gcs, "kv_keys",
+                               {"prefix": "@timeline/"},
+                               timeout=10).get("keys", [])
+            for k in keys:
+                v = w.call_sync(w.gcs, "kv_get", {"key": k},
+                                timeout=10).get("value")
+                if v:
+                    merged.extend(json.loads(v))
+        except Exception:
+            pass
+    if not merged:
+        merged = collect()
+    return [{k: v for k, v in e.items() if v is not None}
+            for e in merged]
