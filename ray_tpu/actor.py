@@ -185,6 +185,7 @@ class ActorClass:
         self._cls = cls
         self._default_opts = validate_options(default_opts, is_actor=True)
         self._class_key: Optional[str] = None
+        self._class_key_mgr = None
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -204,8 +205,10 @@ class ActorClass:
 
     def _create(self, opts: Dict[str, Any], args, kwargs) -> ActorHandle:
         w = global_worker()
-        if self._class_key is None:
+        if self._class_key is None or \
+                self._class_key_mgr is not w.function_manager:
             self._class_key = w.function_manager.export(self._cls, kind="cls")
+            self._class_key_mgr = w.function_manager
         actor_id = ActorID.of(w.job_id)
         ser = serialization.serialize((list(args), kwargs))
         resources = resource_dict_from_options(opts, is_actor=True)

@@ -5,3 +5,8 @@ from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.air import session  # noqa: F401
 from ray_tpu.air.session import TrainingResult  # noqa: F401
+from ray_tpu.air.preprocessor import (  # noqa: F401
+    BatchMapper, Chain, LabelEncoder, MinMaxScaler, Preprocessor,
+    StandardScaler)
+from ray_tpu.air.batch_predictor import (  # noqa: F401
+    BatchPredictor, JaxPredictor, Predictor)

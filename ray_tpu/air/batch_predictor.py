@@ -1,0 +1,90 @@
+"""BatchPredictor — offline inference over a Dataset.
+
+Reference analogue: air BatchPredictor + predictor base. A checkpoint's
+model runs over dataset batches via map_batches actors; JAX predictors
+jit once per (bucketed) batch shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base single-process predictor."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs
+                        ) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Dict[str, np.ndarray]
+                ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a flax apply function + params from a checkpoint."""
+
+    def __init__(self, params: Any,
+                 apply_fn: Callable[[Any, np.ndarray], Any],
+                 input_column: str = "x",
+                 output_column: str = "predictions"):
+        import jax
+        self.params = params
+        self._jitted = jax.jit(apply_fn)
+        self.input_column = input_column
+        self.output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *,
+                        apply_fn: Callable,
+                        input_column: str = "x",
+                        output_column: str = "predictions"
+                        ) -> "JaxPredictor":
+        state = checkpoint.to_dict()
+        params = state.get("params") or state.get("state", {}).get(
+            "params") or state
+        return cls(params, apply_fn, input_column, output_column)
+
+    def predict(self, batch):
+        import jax.numpy as jnp
+        x = jnp.asarray(batch[self.input_column])
+        out = np.asarray(self._jitted(self.params, x))
+        res = dict(batch)
+        res[self.output_column] = out
+        return res
+
+
+class BatchPredictor:
+    def __init__(self, checkpoint: Checkpoint,
+                 predictor_cls: Type[Predictor], **predictor_kwargs):
+        self.checkpoint = checkpoint
+        self.predictor_cls = predictor_cls
+        self.predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        predictor_cls: Type[Predictor],
+                        **predictor_kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **predictor_kwargs)
+
+    def predict(self, dataset, *, batch_size: int = 256):
+        """Run inference over every batch of the dataset; returns a new
+        Dataset with the prediction column appended."""
+        checkpoint = self.checkpoint
+        predictor_cls = self.predictor_cls
+        kwargs = self.predictor_kwargs
+        state = {"p": None}
+
+        def _predict(batch):
+            if state["p"] is None:  # one predictor per worker process
+                state["p"] = predictor_cls.from_checkpoint(
+                    checkpoint, **kwargs)
+            return state["p"].predict(batch)
+
+        return dataset.map_batches(_predict, batch_size=batch_size)

@@ -1,0 +1,161 @@
+"""Preprocessors — fit/transform over Datasets.
+
+Reference analogue: ray.air preprocessor base + ray.data.preprocessors
+(StandardScaler, MinMaxScaler, LabelEncoder, Chain, BatchMapper).
+Fitting aggregates statistics across dataset blocks; transform maps
+batches, so it parallelizes over the block tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, dataset) -> "Preprocessor":
+        self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def transform(self, dataset):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} not fitted")
+        return dataset.map_batches(self._transform_batch)
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} not fitted")
+        return self._transform_batch(batch)
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, dataset):
+        raise NotImplementedError
+
+    def _transform_batch(self, batch):
+        raise NotImplementedError
+
+
+def _iter_column(dataset, col: str):
+    for batch in dataset.iter_batches():
+        if col in batch:
+            yield np.asarray(batch[col], np.float64)
+
+
+class StandardScaler(Preprocessor):
+    """z-score scaling per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, dataset):
+        for col in self.columns:
+            n, s, s2 = 0, 0.0, 0.0
+            for arr in _iter_column(dataset, col):
+                n += arr.size
+                s += float(arr.sum())
+                s2 += float((arr ** 2).sum())
+            mean = s / max(n, 1)
+            var = max(s2 / max(n, 1) - mean ** 2, 0.0)
+            self.stats_[col] = (mean, float(np.sqrt(var)) or 1.0)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for col, (mean, std) in self.stats_.items():
+            if col in out:
+                out[col] = ((np.asarray(out[col], np.float64) - mean)
+                            / (std if std > 0 else 1.0)).astype(
+                    np.float32)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, dataset):
+        for col in self.columns:
+            lo, hi = np.inf, -np.inf
+            for arr in _iter_column(dataset, col):
+                lo = min(lo, float(arr.min()))
+                hi = max(hi, float(arr.max()))
+            self.stats_[col] = (lo, hi)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for col, (lo, hi) in self.stats_.items():
+            if col in out:
+                rng = (hi - lo) or 1.0
+                out[col] = ((np.asarray(out[col], np.float64) - lo)
+                            / rng).astype(np.float32)
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: List[Any] = []
+
+    def _fit(self, dataset):
+        seen = set()
+        for batch in dataset.iter_batches():
+            if self.label_column in batch:
+                seen.update(np.asarray(
+                    batch[self.label_column]).tolist())
+        self.classes_ = sorted(seen)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        if self.label_column in out:
+            idx = {c: i for i, c in enumerate(self.classes_)}
+            out[self.label_column] = np.asarray(
+                [idx[v] for v in np.asarray(
+                    out[self.label_column]).tolist()], np.int64)
+        return out
+
+
+class BatchMapper(Preprocessor):
+    """Stateless user-function preprocessor."""
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]],
+                                    Dict[str, np.ndarray]]):
+        self.fn = fn
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, dataset):
+        pass
+
+    def _transform_batch(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def _fit(self, dataset):
+        for p in self.preprocessors:
+            dataset = p.fit_transform(dataset)
+
+    def _transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch
+
+    def transform(self, dataset):
+        for p in self.preprocessors:
+            dataset = p.transform(dataset)
+        return dataset

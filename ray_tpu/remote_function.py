@@ -19,6 +19,7 @@ class RemoteFunction:
         self._fn = fn
         self._default_opts = validate_options(default_opts, is_actor=False)
         self._fn_key: Optional[str] = None
+        self._fn_key_mgr = None  # function manager the key was exported to
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -41,8 +42,11 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs, opts: Dict[str, Any]):
         w = global_worker()
-        if self._fn_key is None:
+        if self._fn_key is None or self._fn_key_mgr is not w.function_manager:
+            # re-export after a cluster restart: the key cache is only
+            # valid for the GCS it was exported to
             self._fn_key = w.function_manager.export(self._fn, kind="fn")
+            self._fn_key_mgr = w.function_manager
         refs = w.submit_task(self._fn_key, self._fn.__name__, args, kwargs,
                              opts)
         num_returns = opts.get("num_returns")

@@ -1,0 +1,110 @@
+"""BC / MARWIL — offline imitation and advantage-weighted learning.
+
+Reference analogue: rllib/algorithms/bc/ and rllib/algorithms/marwil/
+(BC is MARWIL with beta=0). Trains from JsonReader datasets: no env
+interaction for learning; an env may still be configured for
+evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MARWILPolicy(JaxPolicy):
+    def loss(self, params, batch):
+        beta = self.config.get("beta", 1.0)
+        dist_inputs, vf = self.model.apply(
+            {"params": params}, batch[SampleBatch.OBS])
+        logp = self.dist_logp(dist_inputs, batch[SampleBatch.ACTIONS])
+        if beta > 0:
+            # advantage = monte-carlo return - value prediction
+            returns = batch["returns"]
+            adv = returns - vf
+            vf_loss = jnp.mean(adv ** 2)
+            import jax as _jax
+            norm_adv = _jax.lax.stop_gradient(
+                jnp.clip((adv - adv.mean()) / (adv.std() + 1e-8),
+                         -5.0, 5.0))
+            weights = jnp.minimum(jnp.exp(beta * norm_adv), 20.0)
+            imitation = -jnp.mean(weights * logp)
+            total = imitation + self.config.get(
+                "vf_coeff", 1.0) * vf_loss
+            return total, {"imitation_loss": imitation,
+                           "vf_loss": vf_loss,
+                           "mean_weight": jnp.mean(weights)}
+        imitation = -jnp.mean(logp)
+        return imitation, {"imitation_loss": imitation}
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self._config.update({
+            "lr": 1e-4, "beta": 1.0, "vf_coeff": 1.0,
+            "input_path": None, "train_batch_size": 256,
+            "num_iters_per_step": 10,
+        })
+
+    def offline_data(self, *, input_path=None, **kw):
+        if input_path is not None:
+            self._config["input_path"] = input_path
+        self._config.update(kw)
+        return self
+
+
+class MARWIL(Algorithm):
+    _policy_cls = MARWILPolicy
+    _default_config_cls = MARWILConfig
+
+    def setup(self, config):
+        super().setup(config)
+        path = self.config.get("input_path")
+        if not path:
+            raise ValueError("MARWIL/BC needs config['input_path']")
+        self._data = JsonReader(path).read_all()
+        # precompute per-row monte-carlo returns for the vf baseline
+        gamma = self.config.get("gamma", 0.99)
+        returns = np.zeros(self._data.count, np.float32)
+        acc = 0.0
+        rews = np.asarray(self._data[SampleBatch.REWARDS], np.float32)
+        dones = np.asarray(self._data[SampleBatch.DONES], bool)
+        for t in range(self._data.count - 1, -1, -1):
+            if dones[t]:
+                acc = 0.0
+            acc = rews[t] + gamma * acc
+            returns[t] = acc
+        self._data["returns"] = returns
+        self._rng = np.random.default_rng(self.config.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        bs = self.config["train_batch_size"]
+        stats: Dict[str, float] = {}
+        for _ in range(self.config.get("num_iters_per_step", 10)):
+            idx = self._rng.integers(self._data.count, size=bs)
+            minibatch = SampleBatch(
+                {k: np.asarray(v)[idx] for k, v in self._data.items()})
+            stats = policy.learn_on_batch(minibatch)
+            self._timesteps_total += bs
+        self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": 0,
+                **{f"learner/{k}": v for k, v in stats.items()}}
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self._config["beta"] = 0.0  # pure imitation
+
+
+class BC(MARWIL):
+    _default_config_cls = BCConfig

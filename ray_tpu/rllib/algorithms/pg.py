@@ -1,0 +1,99 @@
+"""PG (vanilla policy gradient / REINFORCE) and A2C.
+
+Reference analogue: rllib/algorithms/pg/ and rllib/algorithms/a2c/.
+Both reuse the PPO rollout machinery (GAE postprocessing) with simpler
+jitted losses: PG uses full-return advantages, A2C the one-network
+actor-critic loss without PPO clipping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.rollout_worker import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class PGPolicy(JaxPolicy):
+    def postprocess_trajectory(self, batch):
+        from ray_tpu.rllib.postprocessing import \
+            compute_gae_for_sample_batch
+        # lambda=1 GAE == discounted-return advantages (REINFORCE w/
+        # value baseline if vf present)
+        return compute_gae_for_sample_batch(
+            self, batch, self.config.get("gamma", 0.99), 1.0)
+
+    def loss(self, params, batch):
+        dist_inputs, _ = self.model.apply(
+            {"params": params}, batch[SampleBatch.OBS])
+        logp = self.dist_logp(dist_inputs, batch[SampleBatch.ACTIONS])
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg_loss = -jnp.mean(logp * adv)
+        return pg_loss, {"policy_loss": pg_loss,
+                         "entropy": jnp.mean(
+                             self.dist_entropy(dist_inputs))}
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PG)
+        self._config.update({"lr": 4e-3, "train_batch_size": 500})
+
+
+class PG(Algorithm):
+    _policy_cls = PGPolicy
+    _default_config_cls = PGConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=self.config["train_batch_size"])
+        self._timesteps_total += batch.count
+        stats = self.workers.local_worker.policy.learn_on_batch(batch)
+        self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": batch.count,
+                **{f"learner/{k}": v for k, v in stats.items()}}
+
+
+class A2CPolicy(JaxPolicy):
+    def postprocess_trajectory(self, batch):
+        from ray_tpu.rllib.postprocessing import \
+            compute_gae_for_sample_batch
+        return compute_gae_for_sample_batch(
+            self, batch, self.config.get("gamma", 0.99),
+            self.config.get("lambda", 1.0))
+
+    def loss(self, params, batch):
+        cfg = self.config
+        dist_inputs, vf = self.model.apply(
+            {"params": params}, batch[SampleBatch.OBS])
+        logp = self.dist_logp(dist_inputs, batch[SampleBatch.ACTIONS])
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean((vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
+        entropy = jnp.mean(self.dist_entropy(dist_inputs))
+        total = (pg_loss + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+                 - cfg.get("entropy_coeff", 0.01) * entropy)
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A2C)
+        self._config.update({
+            "lr": 1e-3, "train_batch_size": 500,
+            "vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+            "grad_clip": 40.0, "lambda": 1.0,
+        })
+
+
+class A2C(PG):
+    _policy_cls = A2CPolicy
+    _default_config_cls = A2CConfig

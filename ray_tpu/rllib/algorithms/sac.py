@@ -1,0 +1,256 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference analogue: rllib/algorithms/sac/. Twin Q-networks, squashed
+Gaussian policy, entropy temperature auto-tuning; the whole
+actor+critic+alpha update is one jitted program over replayed batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.rollout_worker import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class _SACNets(nn.Module):
+    act_dim: int
+    hidden: int = 256
+
+    def setup(self):
+        self.pi_net = nn.Sequential([
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(2 * self.act_dim)])
+        self.q1_net = nn.Sequential([
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.hidden), nn.relu, nn.Dense(1)])
+        self.q2_net = nn.Sequential([
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.hidden), nn.relu, nn.Dense(1)])
+
+    def pi(self, obs):
+        out = self.pi_net(obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    def q(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        return self.q1_net(x)[..., 0], self.q2_net(x)[..., 0]
+
+    def __call__(self, obs, act):
+        # init-time wiring only
+        return self.pi(obs), self.q(obs, act)
+
+
+def _squash(mean, log_std, rng):
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    # log-prob with tanh correction
+    logp = jnp.sum(
+        -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2 * jnp.pi)
+        - jnp.log(1 - act ** 2 + 1e-6), axis=-1)
+    return act, logp
+
+
+class SACPolicy:
+    """Standalone policy (does not reuse JaxPolicy's single-net layout).
+    Presents the same worker-facing API: compute_actions /
+    postprocess_trajectory / get,set_weights."""
+
+    def __init__(self, obs_space, action_space, config: Dict[str, Any]):
+        assert isinstance(action_space, Box), "SAC is continuous-only"
+        self.observation_space = obs_space
+        self.action_space = action_space
+        self.config = config
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32)
+        self.high = np.asarray(action_space.high, np.float32)
+        self.model = _SACNets(self.act_dim)
+        self._rng = jax.random.PRNGKey(config.get("seed") or 0)
+        obs_dim = obs_space.shape or (1,)
+        dummy_o = jnp.zeros((1, *obs_dim), jnp.float32)
+        dummy_a = jnp.zeros((1, self.act_dim), jnp.float32)
+        self.params = self.model.init(self._next_rng(), dummy_o,
+                                      dummy_a)["params"]
+        self.target_params = jax.tree_util.tree_map(jnp.copy,
+                                                    self.params)
+        self.log_alpha = jnp.zeros(())
+        self.optimizer = optax.adam(config.get("lr", 3e-4))
+        self.opt_state = self.optimizer.init(
+            (self.params, self.log_alpha))
+        self._jit_act = jax.jit(self._act_impl)
+        self._jit_update = jax.jit(self._update_impl)
+        self.global_timestep = 0
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _act_impl(self, params, obs, rng, explore):
+        mean, log_std = self.model.apply({"params": params}, obs,
+                                         method=_SACNets.pi)
+        stoch, _ = _squash(mean, log_std, rng)
+        act = jnp.where(explore, stoch, jnp.tanh(mean))
+        return act
+
+    def compute_actions(self, obs, explore=True):
+        act = np.asarray(self._jit_act(self.params, jnp.asarray(obs),
+                                       self._next_rng(), explore))
+        scaled = self.low + (act + 1.0) * 0.5 * (self.high - self.low)
+        n = len(scaled)
+        return scaled, {
+            SampleBatch.ACTION_LOGP: np.zeros(n, np.float32),
+            SampleBatch.ACTION_DIST_INPUTS: np.zeros(
+                (n, 2 * self.act_dim), np.float32),
+            SampleBatch.VF_PREDS: np.zeros(n, np.float32),
+            "raw_actions": act,
+        }
+
+    def postprocess_trajectory(self, batch):
+        return batch  # off-policy: no advantage computation
+
+    def _update_impl(self, params, target_params, log_alpha, opt_state,
+                     batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        target_entropy = -float(self.act_dim)
+        obs = batch[SampleBatch.OBS]
+        nobs = batch[SampleBatch.NEXT_OBS]
+        acts = batch["raw_actions"]
+        rews = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+        rng1, rng2 = jax.random.split(rng)
+
+        # target Q
+        mean_n, log_std_n = self.model.apply(
+            {"params": target_params}, nobs, method=_SACNets.pi)
+        next_a, next_logp = _squash(mean_n, log_std_n, rng1)
+        tq1, tq2 = self.model.apply({"params": target_params}, nobs,
+                                    next_a, method=_SACNets.q)
+        alpha = jnp.exp(log_alpha)
+        target_q = rews + gamma * not_done * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def loss_fn(trainables):
+            p, la = trainables
+            q1, q2 = self.model.apply({"params": p}, obs, acts,
+                                      method=_SACNets.q)
+            critic_loss = jnp.mean((q1 - target_q) ** 2
+                                   + (q2 - target_q) ** 2)
+            mean, log_std = self.model.apply({"params": p}, obs,
+                                             method=_SACNets.pi)
+            new_a, new_logp = _squash(mean, log_std, rng2)
+            nq1, nq2 = self.model.apply({"params": p}, obs, new_a,
+                                        method=_SACNets.q)
+            actor_loss = jnp.mean(
+                jnp.exp(jax.lax.stop_gradient(la)) * new_logp
+                - jnp.minimum(nq1, nq2))
+            alpha_loss = -jnp.mean(
+                la * jax.lax.stop_gradient(new_logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "alpha": jnp.exp(la),
+                           "mean_q": jnp.mean(q1)}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)((params, log_alpha))
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, (params, log_alpha))
+        params, log_alpha = optax.apply_updates((params, log_alpha),
+                                                updates)
+        tau = cfg.get("tau", 0.005)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+        stats = dict(stats)
+        stats["total_loss"] = loss_val
+        return params, target_params, log_alpha, opt_state, stats
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if isinstance(v, np.ndarray) and v.dtype != object}
+        (self.params, self.target_params, self.log_alpha,
+         self.opt_state, stats) = self._jit_update(
+            self.params, self.target_params, self.log_alpha,
+            self.opt_state, jbatch, self._next_rng())
+        self.global_timestep += batch.count
+        return {k: float(v) for k, v in stats.items()}
+
+    def value(self, obs):
+        return np.zeros(len(obs), np.float32)
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self):
+        return {"weights": self.get_weights(),
+                "target": jax.device_get(self.target_params),
+                "log_alpha": float(self.log_alpha),
+                "opt_state": jax.device_get(self.opt_state),
+                "global_timestep": self.global_timestep}
+
+    def set_state(self, state):
+        self.set_weights(state["weights"])
+        self.target_params = jax.tree_util.tree_map(
+            jnp.asarray, state["target"])
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"],
+            is_leaf=lambda x: isinstance(x, (np.ndarray, np.generic)))
+        self.global_timestep = state.get("global_timestep", 0)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self._config.update({
+            "lr": 3e-4, "tau": 0.005,
+            "replay_buffer_capacity": 100_000,
+            "learning_starts": 256,
+            "train_batch_size": 256,
+            "rollout_fragment_length": 1,
+            "training_intensity": 1,
+        })
+
+
+class SAC(Algorithm):
+    _policy_cls = SACPolicy
+    _default_config_cls = SACConfig
+
+    def setup(self, config):
+        super().setup(config)
+        self.replay = ReplayBuffer(
+            self.config["replay_buffer_capacity"],
+            seed=self.config.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        batch = synchronous_parallel_sample(self.workers)
+        self._timesteps_total += batch.count
+        self.replay.add(batch)
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(max(1, cfg.get("training_intensity", 1))):
+                stats = policy.learn_on_batch(
+                    self.replay.sample(cfg["train_batch_size"]))
+            self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": batch.count,
+                "replay_size": len(self.replay),
+                **{f"learner/{k}": v for k, v in stats.items()}}

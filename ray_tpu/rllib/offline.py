@@ -1,0 +1,82 @@
+"""Offline RL IO: JSON writers/readers of SampleBatches.
+
+Reference analogue: rllib/offline/ (json_writer.py, json_reader.py,
+dataset readers). Batches serialize as JSON-lines with base64 numpy
+columns, partitioned into rolling files.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import json
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _encode_col(v: np.ndarray) -> dict:
+    v = np.asarray(v)
+    return {"dtype": str(v.dtype), "shape": list(v.shape),
+            "data": base64.b64encode(v.tobytes()).decode()}
+
+
+def _decode_col(doc: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(doc["data"]),
+        dtype=np.dtype(doc["dtype"])).reshape(doc["shape"]).copy()
+
+
+class JsonWriter:
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_file_size = max_file_size
+        self._file = None
+        self._file_index = 0
+
+    def _rotate(self):
+        if self._file is not None:
+            self._file.close()
+        self._file_index += 1
+        self._file = open(os.path.join(
+            self.path, f"output-{self._file_index:05d}.json"), "w")
+
+    def write(self, batch: SampleBatch):
+        if self._file is None or \
+                self._file.tell() > self.max_file_size:
+            self._rotate()
+        doc = {k: _encode_col(v) for k, v in batch.items()
+               if isinstance(v, np.ndarray) and v.dtype != object}
+        self._file.write(json.dumps(doc) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    def __init__(self, path: str):
+        self.files = sorted(glob.glob(os.path.join(path, "*.json"))) \
+            if os.path.isdir(path) else [path]
+        if not self.files:
+            raise ValueError(f"no offline data under {path!r}")
+
+    def read_all(self) -> SampleBatch:
+        return SampleBatch.concat_samples(list(self))
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for f in self.files:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    doc = json.loads(line)
+                    yield SampleBatch(
+                        {k: _decode_col(v) for k, v in doc.items()})

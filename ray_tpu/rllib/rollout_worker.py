@@ -50,9 +50,7 @@ class RolloutWorker:
             k: [] for k in (SampleBatch.OBS, SampleBatch.ACTIONS,
                             SampleBatch.REWARDS, SampleBatch.DONES,
                             SampleBatch.TRUNCATEDS, SampleBatch.NEXT_OBS,
-                            SampleBatch.EPS_ID, SampleBatch.ACTION_LOGP,
-                            SampleBatch.ACTION_DIST_INPUTS,
-                            SampleBatch.VF_PREDS)}
+                            SampleBatch.EPS_ID)}
         explore = self.config.get("explore", True)
         for _ in range(frag_len):
             actions, extras = self.policy.compute_actions(
@@ -70,10 +68,10 @@ class RolloutWorker:
             cols[SampleBatch.TRUNCATEDS].append(truncs)
             cols[SampleBatch.NEXT_OBS].append(true_next)
             cols[SampleBatch.EPS_ID].append(self._eps_ids.copy())
-            for k in (SampleBatch.ACTION_LOGP,
-                      SampleBatch.ACTION_DIST_INPUTS,
-                      SampleBatch.VF_PREDS):
-                cols[k].append(extras[k])
+            # every policy extra (logp, dist inputs, vf preds, algo-
+            # specific columns like SAC's raw_actions) becomes a column
+            for k, v in extras.items():
+                cols.setdefault(k, []).append(v)
             self._episode_rewards += rews
             self._episode_lens += 1
             finished = terms | truncs

@@ -41,6 +41,11 @@ class BackendExecutor:
     def _setup_backend(self):
         wg = self.worker_group
         n = wg.num_workers
+        # framework backends (TorchConfig etc.) own their rendezvous
+        if self.backend_config is not None and hasattr(
+                self.backend_config, "setup_worker_group"):
+            self.backend_config.setup_worker_group(wg)
+            return
         if n > 1:
             # coordinator on rank 0's host (reference: rank-0 TCP rendezvous,
             # train/torch/config.py:113 — here it's jax.distributed's

@@ -68,6 +68,33 @@ class TrainWorker:
                                    process_id=process_id)
         return True
 
+    def setup_torch_distributed(self, init_method: str,
+                                world_size: int, rank: int,
+                                backend: str = "gloo",
+                                timeout_s: float = 120.0) -> bool:
+        """torch.distributed process group over our actor gang
+        (reference: train/torch/config.py:69 _setup_torch_process_group
+        — gloo on CPU hosts; NCCL has no TPU meaning)."""
+        import datetime
+        import torch.distributed as dist
+        dist.init_process_group(
+            backend=backend, init_method=init_method,
+            world_size=world_size, rank=rank,
+            timeout=datetime.timedelta(seconds=timeout_s))
+        import os
+        os.environ["WORLD_SIZE"] = str(world_size)
+        os.environ["RANK"] = str(rank)
+        return True
+
+    def shutdown_torch_distributed(self) -> bool:
+        try:
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+        except Exception:
+            pass
+        return True
+
     def get_free_port(self) -> int:
         import socket
         s = socket.socket()
