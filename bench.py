@@ -1,6 +1,6 @@
 """Flagship benchmark: ResNet-50 synthetic-data training throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Metric: ResNet-50 images/sec/chip, bf16, synthetic ImageNet shapes —
 the reference's headline Train benchmark (reference:
@@ -9,8 +9,20 @@ torchvision resnet50 under TorchTrainer/DDP). Baseline: 2500 images/s per
 A100 (MLPerf-class DDP throughput on the reference's GPU templates); the
 north star (BASELINE.json) is matching A100 throughput per chip.
 
-Runs on whatever jax backend is present: the real TPU chip under the
-driver, or CPU (tiny shapes) for smoke runs.
+Hardening (round-1 BENCH failed with a transient backend `Unavailable`;
+backend init can also HANG outright when the TPU tunnel stalls):
+  - the benchmark body runs in a supervised child process; the supervisor
+    requires a backend-ready marker within a timeout, kills a hung child,
+    and retries with backoff — an in-process retry loop cannot recover
+    from a hung PJRT client init;
+  - if the TPU never comes up, a forced-CPU child still produces an
+    honest (clearly labeled) number;
+  - any unrecoverable failure still emits the ONE JSON line (value 0,
+    "error" field) instead of a traceback, so the driver always parses.
+
+Extras reported alongside the headline number: avg step time, compile
+time, per-step FLOPs (from the compiled program's XLA cost analysis), and
+MFU against the chip's peak bf16 FLOPs.
 """
 
 from __future__ import annotations
@@ -21,8 +33,158 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0  # A100 MLPerf-class ResNet-50 DDP
 
+METRIC = "resnet50_images_per_sec_per_chip"
+UNIT = "images/s/chip"
+
+# Peak dense bf16 FLOP/s per chip, keyed by substring of device_kind.
+_PEAK_BF16 = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+READY_MARKER = "#BENCH_BACKEND_READY"
+INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", 300))
+RUN_TIMEOUT_S = float(os.environ.get("BENCH_RUN_TIMEOUT", 2400))
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 3))
+
+
+def _emit(value, vs_baseline, **extras):
+    line = {"metric": METRIC, "value": value, "unit": UNIT,
+            "vs_baseline": vs_baseline}
+    line.update(extras)
+    print(json.dumps(line))
+
+
+def _compile_step(step_fn, state, batch):
+    """AOT-compile the train step once; return (callable, flops, seconds).
+
+    The compiled executable is used both for the timing loop and for the
+    XLA cost analysis, so the (single-core-CPU-smoke-hostile) compile
+    happens exactly once.
+    """
+    t0 = time.perf_counter()
+    try:
+        compiled = step_fn.lower(state, batch).compile()
+    except Exception:
+        return step_fn, None, time.perf_counter() - t0
+    compile_s = time.perf_counter() - t0
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = float(ca.get("flops", 0.0))
+        flops = f if f > 0 else None
+    except Exception:
+        pass
+    return compiled, flops, compile_s
+
+
+def _child_main():
+    """Runs in the supervised child: init backend, signal readiness, run."""
+    import sys
+
+    if os.environ.get("_BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    devices = jax.devices()
+    print(f"{READY_MARKER} platform={devices[0].platform}", flush=True)
+    _run(devices)
+
+
+def _supervise():
+    """Spawn the benchmark child; kill + retry if backend init hangs or
+    fails; fall back to a labeled CPU run; always emit one JSON line."""
+    import subprocess
+    import sys
+    import threading
+
+    def attempt(force_cpu: bool):
+        env = dict(os.environ, _BENCH_CHILD="1")
+        if force_cpu:
+            env["_BENCH_FORCE_CPU"] = "1"
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, env=env, text=True)
+        lines: list = []
+        got_ready = threading.Event()
+        done = threading.Event()
+
+        def reader():
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith(READY_MARKER):
+                    got_ready.set()
+                elif line:
+                    lines.append(line)
+            done.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        if not got_ready.wait(INIT_TIMEOUT_S):
+            proc.kill()
+            return None, "backend init timed out"
+        if not done.wait(RUN_TIMEOUT_S):
+            proc.kill()
+            return None, "benchmark run timed out"
+        proc.wait()
+        for line in reversed(lines):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+        return None, f"child exited rc={proc.returncode} with no JSON"
+
+    errors = []
+    delay = 5.0
+    for i in range(ATTEMPTS):
+        result, err = attempt(force_cpu=False)
+        if result is not None and not result.get("error"):
+            print(json.dumps(result))
+            return
+        errors.append(err or result.get("error"))
+        time.sleep(delay)
+        delay = min(delay * 2, 30.0)
+
+    # TPU never came up: labeled CPU fallback so the driver still gets a
+    # real measured number from the same code path.
+    result, err = attempt(force_cpu=True)
+    if result is not None:
+        result["fallback"] = "cpu"
+        result["tpu_errors"] = errors[:3]
+        print(json.dumps(result))
+        return
+    errors.append(err)
+    _emit(0.0, 0.0, error="; ".join(str(e) for e in errors)[:500])
+
 
 def main():
+    if os.environ.get("_BENCH_CHILD"):
+        try:
+            _child_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses this line
+            _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}"[:500])
+    else:
+        _supervise()
+
+
+def _run(devices):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -31,7 +193,7 @@ def main():
     from ray_tpu.parallel.mesh import MeshSpec
     from ray_tpu.train.spmd import make_image_classifier_trainer, put_batch
 
-    platform = jax.devices()[0].platform
+    platform = devices[0].platform
     on_tpu = platform == "tpu"
     n_dev = jax.local_device_count()
 
@@ -60,29 +222,51 @@ def main():
     labels = rng.integers(0, 1000, (batch,), dtype=np.int32)
     dev_batch = put_batch(trainer, {"image": images, "label": labels})
 
+    step, flops_per_step, compile_s = _compile_step(
+        trainer.step, state, dev_batch)
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+
     # NB: sync via device_get of the final loss, not block_until_ready —
     # the serial state dependency forces every queued step to finish, and
     # device_get is a proven barrier on the tunneled TPU platform here.
     for _ in range(warmup):
-        state, metrics = trainer.step(state, dev_batch)
+        state, metrics = step(state, dev_batch)
     float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = trainer.step(state, dev_batch)
+        state, metrics = step(state, dev_batch)
     float(jax.device_get(metrics["loss"]))
     dt = time.perf_counter() - t0
 
+    if profile_dir:
+        jax.profiler.stop_trace()
+
+    step_time = dt / steps
     img_per_sec = batch * steps / dt
     img_per_sec_per_chip = img_per_sec / n_dev
 
-    print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(img_per_sec_per_chip, 2),
-        "unit": "images/s/chip",
-        "vs_baseline": round(
-            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
-    }))
+    extras = {
+        "platform": platform,
+        "n_chips": n_dev,
+        "batch_per_chip": batch // n_dev,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "compile_s": round(compile_s, 2),
+    }
+    if flops_per_step:
+        extras["flops_per_step"] = flops_per_step
+        peak = _peak_flops(devices[0].device_kind)
+        if peak:
+            extras["mfu"] = round(
+                flops_per_step / step_time / (peak * n_dev), 4)
+            extras["peak_bf16_flops_per_chip"] = peak
+
+    _emit(round(img_per_sec_per_chip, 2),
+          round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+          **extras)
 
 
 if __name__ == "__main__":

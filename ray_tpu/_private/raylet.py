@@ -43,12 +43,25 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol
 from ray_tpu._private.object_store import PlasmaxStore
+from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu.common.config import SystemConfig
 from ray_tpu.common.ids import ObjectID
 
 logger = logging.getLogger(__name__)
 
 CHUNK = 4 * 1024 * 1024
+
+
+def _write_file(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
 
 
 def detect_tpu_chips(config: SystemConfig) -> int:
@@ -144,10 +157,16 @@ class Raylet:
         self.available = dict(self.total_resources)
         self.tpu_info = detect_tpu_topology()
         self.free_chips: List[int] = list(range(int(num_tpus)))
-        # placement group reservations: (pg_id, bundle_index) -> resources
+        # placement group reservations: (pg_id, bundle_index) -> resources.
+        # TPU demands reserve *concrete chip IDs* at prepare time (reference:
+        # placement_group_resource_manager.cc converts bundle resources into
+        # node-local instances) — two committed bundles own disjoint chip
+        # sets, and non-PG tasks can never drain a bundle's reserved chips.
         self.prepared_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self.committed_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
         self.pg_available: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self.prepared_bundle_chips: Dict[Tuple[str, int], List[int]] = {}
+        self.pg_chips: Dict[Tuple[str, int], List[int]] = {}
 
         store_path = os.path.join("/dev/shm" if os.path.isdir("/dev/shm")
                                   else session_dir,
@@ -158,6 +177,14 @@ class Raylet:
                          or config.object_store_memory_bytes),
             create=True)
         self.store_path = store_path
+
+        # object spilling (reference: local_object_manager.h:110 SpillObjects
+        # + _private/external_storage.py filesystem backend): pinned primary
+        # copies are written to disk under session_dir and deleted from shm
+        # when the store crosses the spill threshold; restored on demand.
+        self.spill_dir = os.path.join(session_dir, f"spill_{node_id[:12]}")
+        self.spilled: Dict[str, Tuple[str, int]] = {}  # oid hex -> (path, size)
+        self.pinned: Dict[str, Dict[str, Any]] = {}  # oid hex -> {owner}, FIFO
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}  # keyed by env hash
@@ -187,6 +214,8 @@ class Raylet:
             "fetch_object": self.handle_fetch_object,
             "free_objects": self.handle_free_objects,
             "pin_object": self.handle_pin_object,
+            "request_spill": self.handle_request_spill,
+            "contains_object": self.handle_contains_object,
             "get_info": self.handle_get_info,
             "cancel_task": self.handle_cancel_task,
             "_on_disconnect": self._on_disconnect,
@@ -412,7 +441,7 @@ class Raylet:
                 return False
             return all(pool.get(k, 0) + 1e-9 >= v
                        for k, v in ptask.demand.items() if k != "TPU") and \
-                len(self.free_chips) >= ptask.tpu_demand
+                len(self.pg_chips.get(key, ())) >= ptask.tpu_demand
         for k, v in ptask.demand.items():
             if self.available.get(k, 0) + 1e-9 < v:
                 return False
@@ -420,14 +449,16 @@ class Raylet:
 
     def _acquire_resources(self, ptask: PendingTask) -> Tuple[int, ...]:
         key = self._bundle_key(ptask.spec)
-        pool = self.pg_available.get(key) if key is not None else self.available
+        if key is not None:
+            pool = self.pg_available[key]
+            chip_src = self.pg_chips.setdefault(key, [])
+        else:
+            pool = self.available
+            chip_src = self.free_chips
         for k, v in ptask.demand.items():
             pool[k] = pool.get(k, 0) - v
-        if key is not None:
-            # PG tasks also consume node-level TPU chips
-            pass
-        chips = tuple(self.free_chips[:ptask.tpu_demand])
-        del self.free_chips[:ptask.tpu_demand]
+        chips = tuple(chip_src[:ptask.tpu_demand])
+        del chip_src[:ptask.tpu_demand]
         return chips
 
     def _release_resources(self, ptask: PendingTask,
@@ -437,8 +468,13 @@ class Raylet:
         if pool is not None:
             for k, v in ptask.demand.items():
                 pool[k] = pool.get(k, 0) + v
-        self.free_chips.extend(chips)
-        self.free_chips.sort()
+        if key is not None and key in self.pg_available:
+            chip_dst = self.pg_chips.setdefault(key, [])
+        else:
+            # bundle already returned (or plain task): chips rejoin the node
+            chip_dst = self.free_chips
+        chip_dst.extend(chips)
+        chip_dst.sort()
 
     def _infeasible(self, ptask: PendingTask) -> bool:
         """Can this node EVER satisfy the demand?"""
@@ -662,11 +698,17 @@ class Raylet:
     async def handle_prepare_bundle(self, payload, conn):
         key = (payload["pg_id"], payload["bundle_index"])
         res = payload["resources"]
+        n_tpu = int(res.get("TPU", 0))
         for k, v in res.items():
             if self.available.get(k, 0) + 1e-9 < v:
                 return {"ok": False}
+        if len(self.free_chips) < n_tpu:
+            return {"ok": False}
         for k, v in res.items():
             self.available[k] = self.available.get(k, 0) - v
+        # reserve concrete chips now so the bundle owns a disjoint set
+        self.prepared_bundle_chips[key] = self.free_chips[:n_tpu]
+        del self.free_chips[:n_tpu]
         self.prepared_bundles[key] = res
         return {"ok": True}
 
@@ -677,6 +719,7 @@ class Raylet:
             return {"ok": False}
         self.committed_bundles[key] = res
         self.pg_available[key] = dict(res)
+        self.pg_chips[key] = self.prepared_bundle_chips.pop(key, [])
         self._dispatch_event.set()
         return {"ok": True}
 
@@ -686,6 +729,8 @@ class Raylet:
         if res is not None:
             for k, v in res.items():
                 self.available[k] = self.available.get(k, 0) + v
+            self.free_chips.extend(self.prepared_bundle_chips.pop(key, []))
+            self.free_chips.sort()
         return {"ok": True}
 
     async def handle_return_bundle(self, payload, conn):
@@ -695,6 +740,10 @@ class Raylet:
         if res is not None:
             for k, v in res.items():
                 self.available[k] = self.available.get(k, 0) + v
+            # idle reserved chips rejoin the node; chips held by a still-
+            # running task of this PG come back via _release_resources
+            self.free_chips.extend(self.pg_chips.pop(key, []))
+            self.free_chips.sort()
         self._dispatch_event.set()
         return {"ok": True}
 
@@ -704,6 +753,9 @@ class Raylet:
         """Serve chunks of a local object to a remote raylet."""
         oid = ObjectID.from_hex(payload["object_id"])
         buf = self.store.get_buffer(oid)
+        if buf is None and oid.hex() in self.spilled:
+            await self._restore_spilled(oid)
+            buf = self.store.get_buffer(oid)
         if buf is None:
             return {"found": False}
         try:
@@ -717,6 +769,9 @@ class Raylet:
 
     async def _fetch_remote_object(self, oid: ObjectID):
         """Pull an object from another node into the local store."""
+        if oid.hex() in self.spilled:  # our own disk copy: restore, done
+            if await self._restore_spilled(oid):
+                return
         r = await self.gcs.call("get_object_locations",
                                 {"object_id": oid.hex()})
         locs = [l for l in r["locations"] if l["node_id"] != self.node_id]
@@ -765,16 +820,25 @@ class Raylet:
         oid = ObjectID.from_hex(payload["object_id"])
         ok = self.store.pin(oid)
         if ok:
+            self.pinned[oid.hex()] = {"owner": payload.get("owner")}
             await self.gcs.call("add_object_location", {
                 "object_id": oid.hex(), "node_id": self.node_id,
                 "owner": payload.get("owner")})
+            self._maybe_spill_soon()
         return {"ok": ok}
 
     async def handle_free_objects(self, payload, conn):
         for hex_id in payload["object_ids"]:
             oid = ObjectID.from_hex(hex_id)
-            self.store.release(oid)  # drop pin
+            if self.pinned.pop(hex_id, None) is not None:
+                self.store.release(oid)  # drop pin
             self.store.delete(oid)
+            ent = self.spilled.pop(hex_id, None)
+            if ent is not None:
+                try:
+                    os.unlink(ent[0])
+                except OSError:
+                    pass
             try:
                 await self.gcs.call("remove_object_location", {
                     "object_id": hex_id, "node_id": self.node_id})
@@ -782,12 +846,116 @@ class Raylet:
                 pass
         return {}
 
+    # ------------------------------------------------------------- spilling
+
+    async def handle_request_spill(self, payload, conn):
+        """Backpressure path: a worker's plasma create failed; make room.
+
+        Reference: create_request_queue.cc backpressure +
+        local_object_manager.h:206 SpillObjectsOfSize.
+        """
+        n = await self._spill_until(int(payload.get("bytes_needed", 0)))
+        return {"spilled": n}
+
+    async def handle_contains_object(self, payload, conn):
+        hex_id = payload["object_id"]
+        present = (self.store.contains(ObjectID.from_hex(hex_id))
+                   or hex_id in self.spilled)
+        return {"present": present}
+
+    def _maybe_spill_soon(self):
+        """Proactive spill when the store crosses the threshold."""
+        cap = self.store.capacity()
+        if cap and self.store.used_bytes() > \
+                self.config.object_spilling_threshold * cap:
+            asyncio.get_running_loop().create_task(self._spill_until(0))
+
+    async def _spill_until(self, bytes_needed: int) -> int:
+        """Spill cold pinned primaries (FIFO = oldest first) to disk until
+        `bytes_needed` could be allocated, or — if 0 — until usage drops
+        below the spill threshold. Returns the number spilled."""
+        cap = self.store.capacity()
+        if bytes_needed:
+            target_free = float(bytes_needed) + 64 * 1024  # block headers
+        else:
+            target_free = cap * (1.0 - self.config.object_spilling_threshold)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        n = 0
+        for hex_id in list(self.pinned.keys()):
+            if cap - self.store.used_bytes() >= target_free:
+                break
+            if await self._spill_one(hex_id):
+                n += 1
+        return n
+
+    async def _spill_one(self, hex_id: str) -> bool:
+        oid = ObjectID.from_hex(hex_id)
+        buf = self.store.get_buffer(oid)
+        if buf is None:
+            self.pinned.pop(hex_id, None)
+            return False
+        path = os.path.join(self.spill_dir, hex_id)
+        try:
+            data = bytes(buf)
+        finally:
+            buf.release()
+            self.store.release(oid)  # the get_buffer ref
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, _write_file, path, data)
+        except OSError:
+            return False
+        self.store.release(oid)  # the pin ref
+        if not self.store.delete(oid):
+            # a reader still maps it: leave it in shm, undo the spill
+            self.store.pin(oid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self.pinned.pop(hex_id, None)
+        self.spilled[hex_id] = (path, len(data))
+        # the GCS location entry stays: this node still owns the primary
+        # copy (on disk); pulls/gets restore it transparently.
+        return True
+
+    async def _restore_spilled(self, oid: ObjectID) -> bool:
+        ent = self.spilled.get(oid.hex())
+        if ent is None:
+            return False
+        path, size = ent
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(None, _read_file, path)
+        except OSError:
+            return False
+        try:
+            self.store.put_bytes(oid, data)
+        except ObjectStoreFullError:
+            await self._spill_until(len(data))
+            try:
+                self.store.put_bytes(oid, data)
+            except ObjectStoreFullError:
+                return False
+        except ValueError:
+            pass  # already restored concurrently
+        if self.store.pin(oid):
+            self.pinned[oid.hex()] = {"owner": None}
+        self.spilled.pop(oid.hex(), None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return True
+
     async def handle_get_info(self, payload, conn):
         return {
             "node_id": self.node_id,
             "resources": self.total_resources,
             "available": self.available,
             "store": self.store.stats(),
+            "num_spilled_objects": len(self.spilled),
             "num_workers": len(self.workers),
             "num_pending_tasks": len(self.pending),
             "tpu": self.tpu_info,

@@ -487,6 +487,25 @@ class Worker:
             oid, in_plasma=ser.total_size > self.config.max_inline_object_size)
         return ObjectRef(oid, self.address)
 
+    def _plasma_create_with_spill(self, oid: ObjectID, size: int):
+        """plasma create with spill backpressure: a full store asks the
+        raylet to spill cold primaries to disk and retries (reference:
+        create_request_queue.cc retry-after-spill semantics)."""
+        from ray_tpu.exceptions import ObjectStoreFullError
+        attempts = 3
+        for i in range(attempts):
+            try:
+                return self.plasma.create(oid, size)
+            except ObjectStoreFullError:
+                if self.raylet is None or i == attempts - 1:
+                    raise
+                try:
+                    self.call_sync(self.raylet, "request_spill",
+                                   {"bytes_needed": size}, timeout=30)
+                except Exception:
+                    raise ObjectStoreFullError(
+                        f"store full and spill request failed for {oid}")
+
     def _store_serialized(self, oid: ObjectID, ser) -> Dict[str, Any]:
         """Store a SerializedObject; returns a result descriptor."""
         if ser.total_size <= self.config.max_inline_object_size:
@@ -494,7 +513,7 @@ class Worker:
             self.memory_store.put(oid, payload)
             return {"object_id": oid.hex(), "inline": payload,
                     "owner": self.address}
-        buf = self.plasma.create(oid, ser.total_size)
+        buf = self._plasma_create_with_spill(oid, ser.total_size)
         ser.write_into(buf)
         buf.release()
         self.plasma.seal(oid)
@@ -926,6 +945,17 @@ class Worker:
             return {"ready": True, "inline": payload_bytes}
         if self.plasma.contains(oid):
             return {"ready": True, "plasma": True, "node_id": self.node_id}
+        # the primary may have been spilled to disk by our raylet — still
+        # ready; borrowers restore it via the pull path
+        if self.raylet is not None:
+            try:
+                r = await self.raylet.call(
+                    "contains_object", {"object_id": oid.hex()})
+                if r.get("present"):
+                    return {"ready": True, "plasma": True,
+                            "node_id": self.node_id}
+            except Exception:
+                pass
         return {"ready": False}
 
     async def _h_borrow_add(self, payload, conn):
@@ -1020,7 +1050,7 @@ class Worker:
     def _ship_return(self, oid: ObjectID, ser) -> Dict[str, Any]:
         if ser.total_size <= self.config.max_inline_object_size:
             return {"object_id": oid.hex(), "inline": ser.to_bytes()}
-        buf = self.plasma.create(oid, ser.total_size)
+        buf = self._plasma_create_with_spill(oid, ser.total_size)
         ser.write_into(buf)
         buf.release()
         self.plasma.seal(oid)

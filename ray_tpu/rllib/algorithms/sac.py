@@ -153,7 +153,12 @@ class SACPolicy:
             mean, log_std = self.model.apply({"params": p}, obs,
                                              method=_SACNets.pi)
             new_a, new_logp = _squash(mean, log_std, rng2)
-            nq1, nq2 = self.model.apply({"params": p}, obs, new_a,
+            # Actor term: gradient flows through the *action* into Q, but
+            # must not touch the Q-network parameters (reference SAC uses
+            # separate optimizers — sac_torch_policy.py optimizer_fn — so
+            # actor gradients never push Q up for policy actions).
+            frozen_p = jax.lax.stop_gradient(p)
+            nq1, nq2 = self.model.apply({"params": frozen_p}, obs, new_a,
                                         method=_SACNets.q)
             actor_loss = jnp.mean(
                 jnp.exp(jax.lax.stop_gradient(la)) * new_logp

@@ -10,6 +10,7 @@ latency with pull-only plumbing.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 
@@ -32,10 +33,14 @@ class LongPollHost:
                timeout: float = 30.0) -> Tuple[int, Any]:
         """Block until version(key) > last_version (or timeout); returns
         (current_version, snapshot)."""
-        deadline = None
+        # One absolute deadline: notify_all fires for *any* key, so each
+        # wakeup must wait only the remaining time, not a fresh `timeout`
+        # (otherwise churn on other keys can block far past `timeout`).
+        deadline = time.monotonic() + timeout
         with self._cv:
             while self._versions.get(key, 0) <= last_version:
-                if not self._cv.wait(timeout=timeout):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
                     break
             return (self._versions.get(key, 0),
                     self._snapshots.get(key))

@@ -143,7 +143,12 @@ class ServeController:
         self._replica_seq += 1
         opts = dict(
             name=f"SERVE_REPLICA::{name}#{self._replica_seq}",
-            max_concurrency=cfg.get("max_concurrent_queries", 100),
+            # Headroom over max_concurrent_queries: check_health/get_metrics
+            # share the replica's concurrency slots with user requests, and
+            # each router independently admits max_concurrent_queries — a
+            # saturated replica must still answer control probes or the
+            # controller kills it while healthy.
+            max_concurrency=cfg.get("max_concurrent_queries", 100) + 4,
             lifetime="detached",
         )
         if cfg.get("ray_actor_options"):

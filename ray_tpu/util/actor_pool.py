@@ -53,6 +53,13 @@ class ActorPool:
         if self._next_return_index >= self._next_task_index:
             raise StopIteration("no pending results")
         from ray_tpu.exceptions import GetTimeoutError
+        if self._next_return_index not in self._index_to_future:
+            # That index was already consumed by get_next_unordered();
+            # mixing the two is undefined ordering (reference ActorPool
+            # raises the same guard).
+            raise ValueError(
+                "get_next() cannot be used after get_next_unordered() "
+                "consumed an earlier result; use one mode consistently.")
         fut = self._index_to_future[self._next_return_index]
         try:
             value = ray_tpu.get(fut, timeout=timeout)

@@ -18,6 +18,7 @@ from typing import Any, Dict, List
 _events: List[Dict[str, Any]] = []
 _lock = threading.Lock()
 _MAX_EVENTS = 10_000  # ring-buffer cap: bounds memory + kv payload
+_total_recorded = 0  # monotonic: dirty-check survives ring trimming
 _flusher_started = False
 
 
@@ -42,9 +43,13 @@ def _ensure_flusher():
 
 
 def record(name, ph, ts, pid=0, tid=0, **kw):
+    global _total_recorded
     with _lock:
         _events.append({"name": name, "ph": ph, "ts": ts, "pid": pid,
                         "tid": tid, **kw})
+        _total_recorded += 1
+        if len(_events) > _MAX_EVENTS:
+            del _events[:len(_events) - _MAX_EVENTS]
 
 
 def record_task(name: str, t0: float, t1: float, pid: int = 0,
@@ -59,6 +64,8 @@ def record_task(name: str, t0: float, t1: float, pid: int = 0,
             "cname": "terrible" if failed else None,
             "cat": "task",
         })
+        global _total_recorded
+        _total_recorded += 1
         if len(_events) > _MAX_EVENTS:
             del _events[:len(_events) - _MAX_EVENTS]
     # async: the background flusher pushes to GCS so the task-completion
@@ -71,22 +78,25 @@ def collect() -> List[Dict[str, Any]]:
         return list(_events)
 
 
-_last_pushed_len = 0
+_last_pushed_total = 0
 
 
 def flush():
     """Push this process's buffer to GCS KV under a per-pid key (no-op
-    when nothing new was recorded since the previous push)."""
-    global _last_pushed_len
+    when nothing new was recorded since the previous push). Dirty check
+    uses the monotonic recorded-event counter — the buffer *length*
+    plateaus at the ring cap, which would make a length-based check a
+    permanent no-op once 10k events accumulate."""
+    global _last_pushed_total
     from ray_tpu._private import worker as worker_mod
     w = worker_mod._global_worker
     if w is None or not w.connected:
         return
     with _lock:
-        if len(_events) == _last_pushed_len:
+        if _total_recorded == _last_pushed_total:
             return
         events = list(_events)
-        _last_pushed_len = len(events)
+        _last_pushed_total = _total_recorded
     try:
         w.call_sync(w.gcs, "kv_put", {
             "key": f"@timeline/{w.node_id[:8]}-{os.getpid()}",

@@ -1,0 +1,125 @@
+"""Placement group tests: TPU chip reservation + basic PG semantics.
+
+Reference analogue: python/ray/tests/test_placement_group*.py; the chip
+reservation semantics under test mirror how the reference converts bundle
+resources into node-local resource *instances*
+(placement_group_resource_manager.cc) so bundles own disjoint GPU/TPU sets.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@pytest.fixture(scope="function")
+def tpu4_cluster():
+    ctx = ray_tpu.init(num_cpus=4, num_tpus=4, ignore_reinit_error=True,
+                       object_store_memory=64 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _chips_in_bundle(pg, bundle_index=0, num_tpus=1):
+    @ray_tpu.remote(num_cpus=0.5, num_tpus=num_tpus,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        pg, placement_group_bundle_index=bundle_index))
+    def which_chips():
+        return ray_tpu.get_tpu_ids()
+
+    return which_chips
+
+
+def test_two_tpu_bundles_get_disjoint_chips(tpu4_cluster):
+    pg1 = placement_group([{"CPU": 1, "TPU": 2}])
+    pg2 = placement_group([{"CPU": 1, "TPU": 2}])
+    assert pg1.ready(timeout=30)
+    assert pg2.ready(timeout=30)
+
+    chips1 = ray_tpu.get(_chips_in_bundle(pg1, num_tpus=2).remote(),
+                         timeout=60)
+    chips2 = ray_tpu.get(_chips_in_bundle(pg2, num_tpus=2).remote(),
+                         timeout=60)
+    assert len(chips1) == 2 and len(chips2) == 2
+    assert set(chips1).isdisjoint(set(chips2)), (chips1, chips2)
+    assert set(chips1) | set(chips2) == {0, 1, 2, 3}
+
+    remove_placement_group(pg1)
+    remove_placement_group(pg2)
+
+
+def test_non_pg_task_cannot_drain_bundle_chips(tpu4_cluster):
+    # Bundle reserves every chip on the node; a non-PG TPU task must wait.
+    pg = placement_group([{"CPU": 1, "TPU": 4}])
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=0.5, num_tpus=1)
+    def wants_a_chip():
+        return ray_tpu.get_tpu_ids()
+
+    ref = wants_a_chip.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=3.0)
+    assert not ready, "non-PG task stole a chip reserved by the bundle"
+
+    # the bundle can still use all four reserved chips meanwhile
+    chips = ray_tpu.get(_chips_in_bundle(pg, num_tpus=4).remote(),
+                        timeout=60)
+    assert sorted(chips) == [0, 1, 2, 3]
+
+    # releasing the PG frees the chips and unblocks the waiting task
+    remove_placement_group(pg)
+    got = ray_tpu.get(ref, timeout=60)
+    assert len(got) == 1
+
+
+def test_sequential_pg_tasks_reuse_bundle_chips(tpu4_cluster):
+    pg = placement_group([{"CPU": 1, "TPU": 2}])
+    assert pg.ready(timeout=30)
+    first = ray_tpu.get(_chips_in_bundle(pg, num_tpus=2).remote(),
+                        timeout=60)
+    second = ray_tpu.get(_chips_in_bundle(pg, num_tpus=2).remote(),
+                         timeout=60)
+    # chips return to the *bundle's* pool, not the node pool
+    assert sorted(first) == sorted(second)
+    remove_placement_group(pg)
+
+
+def test_pg_actor_gets_bundle_chips(tpu4_cluster):
+    pg = placement_group([{"CPU": 1, "TPU": 1}, {"CPU": 1, "TPU": 1}])
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=0.5, num_tpus=1)
+    class ChipHolder:
+        def chips(self):
+            return ray_tpu.get_tpu_ids()
+
+    a = ChipHolder.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=0)).remote()
+    b = ChipHolder.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=1)).remote()
+    ca = ray_tpu.get(a.chips.remote(), timeout=60)
+    cb = ray_tpu.get(b.chips.remote(), timeout=60)
+    assert len(ca) == 1 and len(cb) == 1
+    assert set(ca).isdisjoint(set(cb))
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+    remove_placement_group(pg)
+
+
+def test_removed_pg_returns_chips_to_node(tpu4_cluster):
+    pg = placement_group([{"CPU": 1, "TPU": 4}])
+    assert pg.ready(timeout=30)
+    remove_placement_group(pg)
+
+    @ray_tpu.remote(num_cpus=0.5, num_tpus=4)
+    def all_chips():
+        return ray_tpu.get_tpu_ids()
+
+    chips = ray_tpu.get(all_chips.remote(), timeout=60)
+    assert sorted(chips) == [0, 1, 2, 3]
