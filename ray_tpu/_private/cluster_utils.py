@@ -72,6 +72,12 @@ class Cluster:
         else:
             proc.kill()
         proc.wait(timeout=10)
+        if not allow_graceful and info.get("store_path"):
+            # SIGKILLed raylet can't unlink its own shm segment
+            try:
+                os.unlink(info["store_path"])
+            except OSError:
+                pass
         if info in self.worker_nodes:
             self.worker_nodes.remove(info)
 
@@ -96,9 +102,19 @@ class Cluster:
         import ray_tpu
         ray_tpu.shutdown()
         for info in self.worker_nodes:
+            proc = info["proc"]
             try:
-                info["proc"].kill()
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except Exception:
+                    proc.kill()
             except Exception:
                 pass
+            if info.get("store_path"):
+                try:
+                    os.unlink(info["store_path"])
+                except OSError:
+                    pass
         if self.head is not None:
             self.head.kill_all()

@@ -66,6 +66,54 @@ class NodeProcesses:
                     proc.wait(timeout=5)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+        # The raylet unlinks its shm segment in its SIGTERM handler; if it had
+        # to be SIGKILLed the segment would leak into /dev/shm — unlink here
+        # as a fallback (idempotent).
+        if self.store_path:
+            try:
+                os.unlink(self.store_path)
+            except OSError:
+                pass
+
+
+def sweep_stale_segments():
+    """Remove plasmax segments in /dev/shm whose creating session is gone.
+
+    A segment is stale when no live process has it mapped (checked via
+    /proc/*/maps). Sessions killed with SIGKILL can leak segments; /dev/shm is
+    a fixed-size tmpfs, so leaks eventually starve every later session.
+    """
+    import glob
+    import time as _time
+    now = _time.time()
+    segs = []
+    for seg in glob.glob("/dev/shm/rtpu_plasmax_*"):
+        try:
+            # skip very fresh segments: a concurrently starting raylet sits
+            # between O_CREAT and mmap, so it appears in the glob but in no
+            # /proc/*/maps yet
+            if now - os.path.getmtime(seg) > 30.0:
+                segs.append(seg)
+        except OSError:
+            pass
+    if not segs:
+        return
+    mapped = set()
+    for maps in glob.glob("/proc/[0-9]*/maps"):
+        try:
+            with open(maps) as f:
+                data = f.read()
+        except OSError:
+            continue
+        for seg in segs:
+            if seg in data:
+                mapped.add(seg)
+    for seg in segs:
+        if seg not in mapped:
+            try:
+                os.unlink(seg)
+            except OSError:
+                pass
 
 
 def start_gcs(session_dir: str, config: SystemConfig,
@@ -108,6 +156,7 @@ def start_head(config: SystemConfig,
                object_store_memory: Optional[int] = None,
                session_dir: Optional[str] = None) -> NodeProcesses:
     np_ = NodeProcesses()
+    sweep_stale_segments()
     np_.session_dir = session_dir or new_session_dir()
     np_.gcs_proc = start_gcs(np_.session_dir, config)
     gcs_port = _wait_file(os.path.join(np_.session_dir, "gcs_port"))

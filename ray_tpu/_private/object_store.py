@@ -30,7 +30,11 @@ def _lib() -> ctypes.CDLL:
     if _LIB is None:
         path = os.path.join(os.path.dirname(__file__), "..", "core", "libplasmax.so")
         path = os.path.abspath(path)
-        if not os.path.exists(path):
+        src = os.path.abspath(os.path.join(
+            os.path.dirname(path), "..", "..", "src", "plasmax", "store.cc"))
+        if not os.path.exists(path) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(path)):
             _build_lib(path)
         lib = ctypes.CDLL(path)
         lib.px_segment_size.restype = ctypes.c_uint64
@@ -47,7 +51,7 @@ def _lib() -> ctypes.CDLL:
                                ctypes.POINTER(ctypes.c_uint64),
                                ctypes.POINTER(ctypes.c_uint64)]
         for name in ("px_seal", "px_abort", "px_release", "px_delete",
-                     "px_contains", "px_pin"):
+                     "px_contains", "px_pin", "px_refcount"):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -158,6 +162,10 @@ class PlasmaxStore:
 
     def contains(self, oid: ObjectID) -> bool:
         return bool(self._lib.px_contains(self._base, oid.binary()))
+
+    def refcount(self, oid: ObjectID) -> int:
+        """Debug: shared refcount of the slot, -1 if absent."""
+        return int(self._lib.px_refcount(self._base, oid.binary()))
 
     def pin(self, oid: ObjectID) -> bool:
         return self._lib.px_pin(self._base, oid.binary()) == 0

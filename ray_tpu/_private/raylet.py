@@ -35,6 +35,7 @@ import asyncio
 import hashlib
 import logging
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -185,6 +186,11 @@ class Raylet:
         self.spill_dir = os.path.join(session_dir, f"spill_{node_id[:12]}")
         self.spilled: Dict[str, Tuple[str, int]] = {}  # oid hex -> (path, size)
         self.pinned: Dict[str, Dict[str, Any]] = {}  # oid hex -> {owner}, FIFO
+        # Serializes spill/restore. Two concurrent _spill_one calls on the
+        # same object each hold a read ref, so each sees the other's ref as
+        # "a reader", refuses the delete, and re-pins — leaving the refcount
+        # permanently elevated and the store permanently full.
+        self._spill_lock: Optional[asyncio.Lock] = None
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}  # keyed by env hash
@@ -445,16 +451,34 @@ class Raylet:
         for k, v in ptask.demand.items():
             if self.available.get(k, 0) + 1e-9 < v:
                 return False
-        return True
+        # invariant: available["TPU"] == len(free_chips); check both anyway so
+        # feasibility can never say yes while the concrete chip pool is short
+        # (the round-2 PG race: return_bundle credited TPU counts for chips
+        # still held by an in-flight PG task).
+        return len(self.free_chips) >= ptask.tpu_demand
 
-    def _acquire_resources(self, ptask: PendingTask) -> Tuple[int, ...]:
+    def _acquire_resources(
+            self, ptask: PendingTask) -> Optional[Tuple[int, ...]]:
+        """Atomically acquire demand + concrete chips, or return None.
+
+        Never returns a short chip tuple: either the full demand (including
+        ``tpu_demand`` concrete chip IDs) is covered, or nothing is taken.
+        Callers must treat None as "not feasible right now" and requeue.
+        """
         key = self._bundle_key(ptask.spec)
         if key is not None:
-            pool = self.pg_available[key]
+            pool = self.pg_available.get(key)
+            if pool is None:  # bundle returned while the task waited
+                return None
             chip_src = self.pg_chips.setdefault(key, [])
         else:
             pool = self.available
             chip_src = self.free_chips
+        if len(chip_src) < ptask.tpu_demand:
+            return None
+        for k, v in ptask.demand.items():
+            if pool.get(k, 0) + 1e-9 < v:
+                return None
         for k, v in ptask.demand.items():
             pool[k] = pool.get(k, 0) - v
         chips = tuple(chip_src[:ptask.tpu_demand])
@@ -463,18 +487,30 @@ class Raylet:
 
     def _release_resources(self, ptask: PendingTask,
                            chips: Tuple[int, ...] = ()):
+        # freed capacity may unblock a pending task on every release path
+        self._dispatch_event.set()
         key = self._bundle_key(ptask.spec)
-        pool = self.pg_available.get(key) if key is not None else self.available
-        if pool is not None:
-            for k, v in ptask.demand.items():
-                pool[k] = pool.get(k, 0) + v
-        if key is not None and key in self.pg_available:
-            chip_dst = self.pg_chips.setdefault(key, [])
-        else:
-            # bundle already returned (or plain task): chips rejoin the node
-            chip_dst = self.free_chips
-        chip_dst.extend(chips)
-        chip_dst.sort()
+        if key is not None:
+            pool = self.pg_available.get(key)
+            if pool is not None:
+                for k, v in ptask.demand.items():
+                    pool[k] = pool.get(k, 0) + v
+                chip_dst = self.pg_chips.setdefault(key, [])
+                chip_dst.extend(chips)
+                chip_dst.sort()
+            else:
+                # Bundle already returned: chips rejoin the NODE pool, and the
+                # node's TPU count must follow them here (return_bundle only
+                # credited the chips it physically got back).
+                self.free_chips.extend(chips)
+                self.free_chips.sort()
+                self.available["TPU"] = \
+                    self.available.get("TPU", 0) + len(chips)
+            return
+        for k, v in ptask.demand.items():
+            self.available[k] = self.available.get(k, 0) + v
+        self.free_chips.extend(chips)
+        self.free_chips.sort()
 
     def _infeasible(self, ptask: PendingTask) -> bool:
         """Can this node EVER satisfy the demand?"""
@@ -546,11 +582,19 @@ class Raylet:
                             continue
                     i += 1
                     continue
+                # Acquire synchronously (no await between the feasibility
+                # check and the take) so two pending tasks can never both be
+                # judged feasible against the same availability and then
+                # over-subscribe when their dispatch coroutines run.
+                chips = self._acquire_resources(ptask)
+                if chips is None:
+                    i += 1
+                    continue
                 self.pending.pop(i)
-                asyncio.get_running_loop().create_task(self._dispatch(ptask))
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(ptask, chips))
 
-    async def _dispatch(self, ptask: PendingTask):
-        chips = self._acquire_resources(ptask)
+    async def _dispatch(self, ptask: PendingTask, chips: Tuple[int, ...]):
         env_hash = _env_hash(ptask.spec.get("runtime_env") or {})
         handle = self._pop_idle(env_hash, chips)
         if handle is None:
@@ -655,9 +699,9 @@ class Raylet:
                              "placement_group": spec.get("placement_group"),
                              "task_id": "actor-" + payload["actor_id"],
                              "scheduling": {}}, None)
-        if not self._resources_feasible(ptask):
-            return {"error": "insufficient resources", "retryable": True}
         chips = self._acquire_resources(ptask)
+        if chips is None:
+            return {"error": "insufficient resources", "retryable": True}
         try:
             handle = await self._start_worker(spec.get("runtime_env") or {},
                                               chips)
@@ -738,12 +782,34 @@ class Raylet:
         res = self.committed_bundles.pop(key, None)
         self.pg_available.pop(key, None)
         if res is not None:
+            returned = self.pg_chips.pop(key, [])
             for k, v in res.items():
+                if k == "TPU":
+                    continue
                 self.available[k] = self.available.get(k, 0) + v
-            # idle reserved chips rejoin the node; chips held by a still-
-            # running task of this PG come back via _release_resources
-            self.free_chips.extend(self.pg_chips.pop(key, []))
+            # Only chips physically back in hand rejoin the node pool (and its
+            # TPU count) now; chips held by a still-running task of this PG
+            # come back — and re-credit available["TPU"] — via
+            # _release_resources when that task finishes. Crediting the full
+            # bundle count here let a waiting non-PG task pass feasibility and
+            # acquire an empty chip tuple (round-2 race).
+            self.free_chips.extend(returned)
             self.free_chips.sort()
+            if "TPU" in res:
+                self.available["TPU"] = \
+                    self.available.get("TPU", 0) + len(returned)
+        # tasks still queued against this PG can never run now — fail them
+        pg_id = payload["pg_id"]
+        for i in range(len(self.pending) - 1, -1, -1):
+            pt = self.pending[i]
+            pg = pt.spec.get("placement_group")
+            if pg and pg.get("pg_id") == pg_id:
+                self.pending.pop(i)
+                if pt.reply_fut is not None and not pt.reply_fut.done():
+                    pt.reply_fut.set_result({
+                        "error": "PLACEMENT_GROUP_REMOVED",
+                        "message": f"placement group {pg_id} was removed",
+                    })
         self._dispatch_event.set()
         return {"ok": True}
 
@@ -870,10 +936,20 @@ class Raylet:
                 self.config.object_spilling_threshold * cap:
             asyncio.get_running_loop().create_task(self._spill_until(0))
 
+    def _get_spill_lock(self) -> asyncio.Lock:
+        if self._spill_lock is None:
+            self._spill_lock = asyncio.Lock()
+        return self._spill_lock
+
     async def _spill_until(self, bytes_needed: int) -> int:
+        async with self._get_spill_lock():
+            return await self._spill_until_locked(bytes_needed)
+
+    async def _spill_until_locked(self, bytes_needed: int) -> int:
         """Spill cold pinned primaries (FIFO = oldest first) to disk until
         `bytes_needed` could be allocated, or — if 0 — until usage drops
-        below the spill threshold. Returns the number spilled."""
+        below the spill threshold. Returns the number spilled. Caller must
+        hold the spill lock."""
         cap = self.store.capacity()
         if bytes_needed:
             target_free = float(bytes_needed) + 64 * 1024  # block headers
@@ -892,6 +968,7 @@ class Raylet:
         oid = ObjectID.from_hex(hex_id)
         buf = self.store.get_buffer(oid)
         if buf is None:
+            logger.debug("spill_one %s: no buffer", hex_id[:16])
             self.pinned.pop(hex_id, None)
             return False
         path = os.path.join(self.spill_dir, hex_id)
@@ -908,6 +985,7 @@ class Raylet:
         self.store.release(oid)  # the pin ref
         if not self.store.delete(oid):
             # a reader still maps it: leave it in shm, undo the spill
+            logger.debug("spill_one %s: delete refused (readers)", hex_id[:16])
             self.store.pin(oid)
             try:
                 os.unlink(path)
@@ -921,33 +999,36 @@ class Raylet:
         return True
 
     async def _restore_spilled(self, oid: ObjectID) -> bool:
-        ent = self.spilled.get(oid.hex())
-        if ent is None:
-            return False
-        path, size = ent
-        loop = asyncio.get_running_loop()
-        try:
-            data = await loop.run_in_executor(None, _read_file, path)
-        except OSError:
-            return False
-        try:
-            self.store.put_bytes(oid, data)
-        except ObjectStoreFullError:
-            await self._spill_until(len(data))
+        async with self._get_spill_lock():
+            if self.store.contains(oid):
+                return True  # concurrent restore won
+            ent = self.spilled.get(oid.hex())
+            if ent is None:
+                return False
+            path, size = ent
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(None, _read_file, path)
+            except OSError:
+                return False
             try:
                 self.store.put_bytes(oid, data)
             except ObjectStoreFullError:
-                return False
-        except ValueError:
-            pass  # already restored concurrently
-        if self.store.pin(oid):
-            self.pinned[oid.hex()] = {"owner": None}
-        self.spilled.pop(oid.hex(), None)
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
-        return True
+                await self._spill_until_locked(len(data))
+                try:
+                    self.store.put_bytes(oid, data)
+                except ObjectStoreFullError:
+                    return False
+            except ValueError:
+                pass  # already restored concurrently
+            if self.store.pin(oid):
+                self.pinned[oid.hex()] = {"owner": None}
+            self.spilled.pop(oid.hex(), None)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return True
 
     async def handle_get_info(self, payload, conn):
         return {
@@ -979,11 +1060,15 @@ class Raylet:
         self._shutdown = True
         for h in self.workers.values():
             try:
-                h.proc.terminate()
+                h.proc.kill()
             except Exception:
                 pass
         self.server.close()
         self.store.unlink()
+        try:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
+        except Exception:
+            pass
 
 
 def _env_hash(runtime_env: Dict[str, Any]) -> str:

@@ -4,6 +4,7 @@ import asyncio
 import json
 import logging
 import os
+import signal
 
 from ray_tpu._private.raylet import Raylet
 from ray_tpu.common.config import SystemConfig
@@ -33,7 +34,15 @@ async def main():
     with open(tmp, "w") as f:
         json.dump(info, f)
     os.replace(tmp, os.path.join(session_dir, f"raylet_{node_id[:8]}.json"))
-    await asyncio.Event().wait()
+    # Graceful shutdown on SIGTERM/SIGINT: kill workers and unlink the shm
+    # segment — otherwise every session leaks its plasmax file into /dev/shm
+    # (a fixed-size tmpfs) until the host runs dry.
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    raylet.shutdown()
 
 
 if __name__ == "__main__":

@@ -393,8 +393,15 @@ class Worker:
         self.function_manager = FunctionManager(
             lambda m, p: self.io.run(self.gcs.call(m, p)))
         if raylet_address:
+            on_close = None
+            if mode == MODE_WORKER:
+                # A worker whose raylet vanished (SIGKILL, node death) is an
+                # orphan: nothing can ever schedule onto it again, and leaked
+                # workers keep shm segments mapped. Exit hard.
+                on_close = lambda _conn: os._exit(1)  # noqa: E731
             self.raylet = self.io.run(protocol.connect(
-                raylet_address, handler=self._handle_request))
+                raylet_address, handler=self._handle_request,
+                on_close=on_close))
         if mode == MODE_DRIVER:
             r = self.io.run(self.gcs.call("next_job_id", {}))
             self.job_id = JobID.from_int(r["job_index"])

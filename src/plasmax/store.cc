@@ -396,6 +396,14 @@ int px_contains(void* base, const uint8_t* id) {
   return (s && s->state == kSealed) ? 1 : 0;
 }
 
+// Debug/introspection: current reference count, or -1 if absent.
+int px_refcount(void* base, const uint8_t* id) {
+  Header* h = static_cast<Header*>(base);
+  Locker lk(h);
+  Slot* s = find_slot(base, id);
+  return s ? static_cast<int>(s->refcnt) : -1;
+}
+
 // Pin/unpin: primary copies are pinned by the owning raylet so LRU eviction
 // never drops the last copy (reference: pinned objects in local_object_manager).
 int px_pin(void* base, const uint8_t* id) {
