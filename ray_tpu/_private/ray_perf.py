@@ -1,0 +1,141 @@
+"""Control-plane microbenchmarks: tasks/s, actor calls/s, put/get throughput.
+
+Role-equivalent to the reference's microbenchmark suite
+(reference: python/ray/_private/ray_perf.py:93 and
+ray_microbenchmark_helpers.py timeit) — the numbers that justify (or refute)
+running the L1 runtime as Python asyncio processes instead of C++ on a TPU
+host. A TPU host runs O(1-8) model workers whose step time is 10-100 ms;
+the control plane only has to stay far off the critical path at that scale.
+
+Run: ``python -m ray_tpu._private.ray_perf [--json out.json]``
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: float = 1,
+           reps: int = 3, window_s: float = 1.0,
+           ) -> Tuple[str, float, float]:
+    """Measure fn() calls/s over `reps` windows; returns (name, mean, sd)."""
+    # warmup: run for ~0.3 s
+    start = time.perf_counter()
+    while time.perf_counter() - start < 0.3:
+        fn()
+    rates: List[float] = []
+    for _ in range(reps):
+        count = 0
+        start = time.perf_counter()
+        while time.perf_counter() - start < window_s:
+            fn()
+            count += 1
+        rates.append(multiplier * count / (time.perf_counter() - start))
+    mean = statistics.fmean(rates)
+    sd = statistics.pstdev(rates)
+    print(f"{name}: {mean:,.1f} /s (+- {sd:,.1f})")
+    return (name, mean, sd)
+
+
+def main(json_path: Optional[str] = None) -> Dict[str, float]:
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    results: List[Tuple[str, float, float]] = []
+
+    # ------------------------------------------------------- object plane
+    value = ray_tpu.put(0)
+
+    results.append(timeit("get small (inline)", lambda: ray_tpu.get(value)))
+    results.append(timeit("put small (inline)", lambda: ray_tpu.put(0)))
+
+    arr = np.zeros(100 * 1024 * 1024, dtype=np.int64)  # 800 MB
+
+    def put_large():
+        ref = ray_tpu.put(arr)
+        del ref
+
+    gb = arr.nbytes / 1e9
+    results.append(timeit("put gigabytes (plasma GB/s)", put_large,
+                          multiplier=gb, reps=2))
+    big = ray_tpu.put(arr)
+
+    def get_large():
+        v = ray_tpu.get(big)
+        del v
+
+    results.append(timeit("get 800MB zero-copy (gets/s)", get_large, reps=2))
+
+    # -------------------------------------------------------------- tasks
+    @ray_tpu.remote
+    def small_value():
+        return b"ok"
+
+    results.append(timeit(
+        "tasks sync (round-trips/s)",
+        lambda: ray_tpu.get(small_value.remote())))
+
+    def task_batch():
+        ray_tpu.get([small_value.remote() for _ in range(200)])
+
+    results.append(timeit("tasks async (tasks/s)", task_batch,
+                          multiplier=200, reps=2, window_s=2.0))
+
+    # ------------------------------------------------------------- actors
+    @ray_tpu.remote
+    class Responder:
+        def ping(self):
+            return b"ok"
+
+    a = Responder.remote()
+    ray_tpu.get(a.ping.remote())  # wait for creation
+
+    results.append(timeit(
+        "actor calls sync (round-trips/s)",
+        lambda: ray_tpu.get(a.ping.remote())))
+
+    def actor_batch():
+        ray_tpu.get([a.ping.remote() for _ in range(200)])
+
+    results.append(timeit("actor calls async (calls/s)", actor_batch,
+                          multiplier=200, reps=2, window_s=2.0))
+
+    c = Responder.options(max_concurrency=16).remote()
+    ray_tpu.get(c.ping.remote())
+
+    def actor_concurrent():
+        ray_tpu.get([c.ping.remote() for _ in range(200)])
+
+    results.append(timeit("actor calls concurrent (calls/s)",
+                          actor_concurrent, multiplier=200, reps=2,
+                          window_s=2.0))
+
+    # --------------------------------------------------------------- wait
+    refs = [small_value.remote() for _ in range(100)]
+    ray_tpu.get(refs)
+
+    results.append(timeit(
+        "wait on 100 ready refs (waits/s)",
+        lambda: ray_tpu.wait(refs, num_returns=100, timeout=10)))
+
+    ray_tpu.shutdown()
+
+    summary = {name: mean for name, mean, _ in results}
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    out = None
+    if "--json" in sys.argv:
+        out = sys.argv[sys.argv.index("--json") + 1]
+    main(out)

@@ -566,11 +566,34 @@ class Worker:
 
     def _get_one(self, ref: ObjectRef, deadline) -> Any:
         oid = ref.id()
+        recovery_attempts = 0
         while True:
             # 1. in-process memory store
             payload = self.memory_store.get(oid)
             if payload is not None:
-                return self._deserialize_payload(oid, payload)
+                try:
+                    return self._deserialize_payload(oid, payload)
+                except exc.ObjectLostError:
+                    # stale descriptor: the node holding the primary died.
+                    # Drop it and fall through to recovery — if we own the
+                    # object, lineage reconstruction resubmits the creating
+                    # task (reference: object_recovery_manager.cc). Lineage
+                    # re-execution assumes idempotent tasks, same as the
+                    # reference's ownership model. Attempts are bounded so a
+                    # persistently failing fetch path can't re-execute the
+                    # task forever.
+                    recovery_attempts += 1
+                    if recovery_attempts > 3:
+                        raise
+                    self.memory_store.delete(oid)
+                    if self.mode == MODE_DRIVER or not ref.owner_address() \
+                            or ref.owner_address() == self.address:
+                        self._maybe_reconstruct(oid)
+                    if deadline is not None and \
+                            self._remaining(deadline) <= 0:
+                        raise exc.GetTimeoutError(
+                            f"get() timed out during recovery of {oid}")
+                    continue
             # 2. local plasma
             buf = self.plasma.get_buffer(oid)
             if buf is not None:
@@ -584,13 +607,25 @@ class Worker:
         value = serialization.deserialize(payload)
         if isinstance(value, _PlasmaIndirect):
             # owner sent us a descriptor: the real value sits in plasma
-            self._ensure_local_plasma(oid, value, None)
+            self._ensure_local_plasma(oid)
             buf = self.plasma.get_buffer(oid)
             if buf is None:
                 raise exc.ObjectLostError(oid)
             self.memory_store.delete(oid)
             return self._deserialize_plasma(oid, buf)
         return value
+
+    def _ensure_local_plasma(self, oid: ObjectID) -> None:
+        """Bring a plasma object referenced by a descriptor to this node.
+
+        The descriptor (_PlasmaIndirect) names the node holding the primary;
+        the local raylet pulls it chunk-wise (reference: object directory +
+        PullManager; here raylet.handle_fetch_object)."""
+        try:
+            self._fetch_via_raylet(oid)
+        except Exception as e:
+            raise exc.ObjectLostError(
+                oid, f"primary copy unreachable: {e}") from e
 
     def _deserialize_plasma(self, oid: ObjectID, buf) -> Any:
         try:
@@ -679,6 +714,9 @@ class Worker:
     def _maybe_reconstruct(self, oid: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the creating task (reference:
         object_recovery_manager.h RecoverObject → TaskManager::ResubmitTask)."""
+        state = self.pending_tasks.get(oid.task_id().hex())
+        if state is not None and not state.done:
+            return True  # a resubmit is already in flight
         spec = self.reference_counter.get_lineage(oid)
         if spec is None:
             raise exc.ObjectLostError(oid, "no lineage recorded")

@@ -1,0 +1,200 @@
+"""Fault-tolerance tests: worker death, actor restart, lineage
+reconstruction, node death, spillback.
+
+Reference analogue: python/ray/tests/test_failure*.py,
+test_gcs_fault_tolerance.py, and the NodeKillerActor pattern
+(_private/test_utils.py) per SURVEY.md §4 fault injection. Every recovery
+path in worker.py (_retry, _maybe_reconstruct) and the GCS actor RESTARTING
+state machine gets at least one kill-based test here.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@pytest.fixture(scope="function")
+def ray_4cpu():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_task_retry_on_worker_death(ray_4cpu, tmp_path):
+    marker = str(tmp_path / "died_once")
+
+    @ray_tpu.remote(max_retries=3)
+    def die_once():
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
+        return "survived"
+
+    assert ray_tpu.get(die_once.remote(), timeout=60) == "survived"
+
+
+def test_task_fails_after_retries_exhausted(ray_4cpu):
+    @ray_tpu.remote(max_retries=1)
+    def always_dies():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(always_dies.remote(), timeout=60)
+
+
+def test_actor_restart(ray_4cpu):
+    @ray_tpu.remote(max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=30)
+    os.kill(pid1, signal.SIGKILL)
+
+    # the GCS restarts the actor (state lost: fresh __init__)
+    deadline = time.monotonic() + 60
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(a.incr.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert val == 1, f"restarted actor should have fresh state, got {val}"
+    pid2 = ray_tpu.get(a.pid.remote(), timeout=30)
+    assert pid2 != pid1
+
+
+def test_actor_dead_after_kill(ray_4cpu):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    a = Victim.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.ping.remote(), timeout=15)
+
+
+def test_lineage_reconstruction_after_object_loss(ray_4cpu):
+    @ray_tpu.remote
+    def produce():
+        return np.arange(512 * 1024, dtype=np.int64)  # 4 MB -> plasma
+
+    ref = produce.remote()
+    first = ray_tpu.get(ref, timeout=60)
+    expect = int(first.sum())
+    del first
+
+    # simulate object loss: drop the primary copy from plasma + directory
+    w = ray_tpu._private.worker.global_worker()
+    w.call_sync(w.raylet, "free_objects", {"object_ids": [ref.id().hex()]})
+    w.memory_store.delete(ref.id())
+    assert not w.plasma.contains(ref.id())
+
+    again = ray_tpu.get(ref, timeout=60)  # lineage resubmit
+    assert int(again.sum()) == expect
+
+
+def test_internode_object_pull():
+    """Object produced on node B is pulled to the driver on node A
+    (reference: test_object_manager.py transfer tests)."""
+    from ray_tpu._private.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=2, resources={"nodeB": 1})
+        cluster.connect()
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(resources={"nodeB": 1})
+        def produce_remote():
+            return np.full(1024 * 1024, 7, dtype=np.uint8)  # 1 MB
+
+        v = ray_tpu.get(produce_remote.remote(), timeout=90)
+        assert v.nbytes == 1024 * 1024 and int(v[0]) == 7
+    finally:
+        cluster.shutdown()
+
+
+def test_node_death_lineage_reconstruction():
+    """Kill the node holding the only copy; the owner resubmits the creating
+    task elsewhere (reference: object_recovery_manager + NodeKiller tests)."""
+    from ray_tpu._private.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        info = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+        cluster.connect()
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(max_retries=3)
+        def produce():
+            return np.full(512 * 1024, 3, dtype=np.uint8)
+
+        # force first execution onto the doomed node
+        ref = produce.options(resources={"doomed": 0.5},
+                              max_retries=3).remote()
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+        assert ready
+
+        cluster.remove_node(info)  # SIGKILL: object's only copy is gone
+        time.sleep(1.0)
+        # reconstruction reuses the lineage spec (same resource demand), so
+        # bring up a replacement node carrying the same custom resource —
+        # the pattern the reference's node-failure tests use
+        cluster.add_node(num_cpus=2, resources={"doomed": 1})
+        cluster.wait_for_nodes()
+
+        v = ray_tpu.get(ref, timeout=90)
+        assert int(v[0]) == 3
+    finally:
+        cluster.shutdown()
+
+
+def test_spillback_to_free_node():
+    """A task that does not fit on the head spills to a worker node
+    (reference: spillback scheduling, local_task_manager)."""
+    from ray_tpu._private.cluster_utils import Cluster
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.connect()
+        cluster.wait_for_nodes()
+        head_id = ray_tpu.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote(num_cpus=1)
+        def hold(t):
+            time.sleep(t)
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote(num_cpus=3)
+        def big_task():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        blocker = hold.remote(5.0)  # may land on either node
+        node = ray_tpu.get(big_task.remote(), timeout=60)
+        # 3 CPUs only exist on the worker node
+        assert node != head_id
+        ray_tpu.get(blocker, timeout=60)
+    finally:
+        cluster.shutdown()
