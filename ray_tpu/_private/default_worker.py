@@ -1,12 +1,17 @@
 """Worker process entrypoint (reference:
 python/ray/_private/workers/default_worker.py → RunTaskExecutionLoop)."""
 
+import faulthandler
 import logging
 import os
+import signal
 
 
 def main():
     logging.basicConfig(level=os.environ.get("RTPU_LOG_LEVEL", "WARNING"))
+    # SIGUSR1 dumps all thread stacks to stderr (worker .err log) — the
+    # hung-worker debugging hook (reference: ray SIGTERM stack traces).
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     from ray_tpu._private.worker import Worker, MODE_WORKER
 
     w = Worker()

@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol
+from ray_tpu._private.gcs_store import StoreClient, make_store
 from ray_tpu.common.config import SystemConfig
 
 logger = logging.getLogger(__name__)
@@ -44,16 +45,6 @@ PENDING_CREATION = "PENDING_CREATION"
 ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
-
-
-class InMemoryStore:
-    """Pluggable persistence seam (reference: gcs/store_client/)."""
-
-    def __init__(self):
-        self.tables: Dict[str, Dict[bytes, Any]] = {}
-
-    def table(self, name: str) -> Dict[bytes, Any]:
-        return self.tables.setdefault(name, {})
 
 
 class NodeInfo:
@@ -76,9 +67,14 @@ class NodeInfo:
 
 
 class GcsServer:
-    def __init__(self, config: SystemConfig):
+    def __init__(self, config: SystemConfig,
+                 store_path: Optional[str] = None):
         self.config = config
-        self.store = InMemoryStore()
+        # Persistence (reference: gcs/store_client/ + gcs_init_data.cc):
+        # live state stays in dicts (hot path), every mutation writes through
+        # to the store, and start() replays the store so a restarted GCS
+        # rebuilds actors/PGs/jobs/KV. In-memory backend when no path given.
+        self.store: StoreClient = make_store(store_path)
         self.nodes: Dict[str, NodeInfo] = {}
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, Dict[str, Any]] = {}
@@ -137,19 +133,102 @@ class GcsServer:
         return h
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._load_persisted()
         self.port = await self._server.start_tcp(host, port)
         asyncio.get_running_loop().create_task(self._health_loop())
+        self._resume_interrupted()
         logger.info("GCS listening on %s:%s", host, self.port)
         return self.port
+
+    # ------------------------------------------------------------ persistence
+
+    def _load_persisted(self):
+        """Rebuild manager state from the store (reference:
+        gcs_init_data.cc LoadJobTableData/LoadActorTableData/...)."""
+        restart_actors: List[str] = []
+        restart_pgs: List[str] = []
+        for table, key, value in self.store.load_all():
+            if table == "kv":
+                self.kv[key] = value
+            elif table == "jobs":
+                self.jobs[key] = value
+            elif table == "actors":
+                self.actors[key] = value
+                if value.get("state") in (PENDING_CREATION, RESTARTING,
+                                          DEPS_UNREADY):
+                    restart_actors.append(key)
+            elif table == "named_actors":
+                ns, _, name = key.partition("\x00")
+                self.named_actors[(ns, name)] = value
+            elif table == "pgs":
+                self.placement_groups[key] = value
+                if value.get("state") == "PENDING":
+                    restart_pgs.append(key)
+            elif table == "meta":
+                if key == "next_job_index":
+                    self.next_job_index = int(value)
+        if self.actors or self.placement_groups or self.kv:
+            logger.info(
+                "GCS state rebuilt from store: %d actors, %d PGs, %d jobs, "
+                "%d kv keys", len(self.actors), len(self.placement_groups),
+                len(self.jobs), len(self.kv))
+        self._pending_restart_actors = restart_actors
+        self._pending_restart_pgs = restart_pgs
+
+    def _resume_interrupted(self):
+        """Re-kick scheduling work that was in flight when the GCS died.
+        Called once the server is accepting raylet re-registrations."""
+        for aid in getattr(self, "_pending_restart_actors", []):
+            asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+        for pg_id in getattr(self, "_pending_restart_pgs", []):
+            asyncio.get_running_loop().create_task(self._retry_pg(pg_id))
+        self._pending_restart_actors = []
+        self._pending_restart_pgs = []
+        if self.actors or self.placement_groups:
+            asyncio.get_running_loop().create_task(
+                self._reconcile_after_restart())
+
+    async def _reconcile_after_restart(self):
+        """Nodes that died while the GCS was down never re-register, so
+        persisted ALIVE actors / CREATED PGs pointing at them would hang
+        forever. After a re-registration grace period, fail those actors
+        over (restart policy applies) and re-place those PGs."""
+        await asyncio.sleep(self.config.health_check_timeout_s)
+        live = {nid for nid, n in self.nodes.items() if n.alive}
+        for aid, info in list(self.actors.items()):
+            if info["state"] in (ALIVE, RESTARTING, PENDING_CREATION) and \
+                    info.get("node_id") and info["node_id"] not in live:
+                await self._handle_actor_failure(
+                    aid, "node lost during GCS downtime")
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg.get("state") == "CREATED" and pg.get("assignment") and \
+                    any(nid not in live for nid in pg["assignment"]):
+                pg["state"] = "PENDING"
+                pg["assignment"] = None
+                self._persist_pg(pg_id)
+                asyncio.get_running_loop().create_task(self._retry_pg(pg_id))
+
+    def _persist_actor(self, aid: str):
+        info = self.actors.get(aid)
+        if info is not None:
+            self.store.put("actors", aid, info)
+
+    def _persist_pg(self, pg_id: str):
+        pg = self.placement_groups.get(pg_id)
+        if pg is not None:
+            self.store.put("pgs", pg_id, pg)
 
     async def _on_connect(self, conn):
         pass
 
     async def _on_disconnect(self, conn):
         # raylet connection drop == node death (active health check analogue,
-        # reference: gcs_health_check_manager.cc)
+        # reference: gcs_health_check_manager.cc). A raylet that re-dialed
+        # (ReconnectingConnection) re-registers with a NEW conn before the
+        # old one's EOF is processed — only the node's current conn counts.
         node_id = conn.meta.get("node_id")
-        if node_id and node_id in self.nodes and self.nodes[node_id].alive:
+        if node_id and node_id in self.nodes and self.nodes[node_id].alive \
+                and self.nodes[node_id].conn is conn:
             await self._mark_node_dead(node_id, "raylet disconnected")
         for subs in self.subscribers.values():
             subs.discard(conn)
@@ -190,6 +269,10 @@ class GcsServer:
         info = NodeInfo(node_id, payload, conn)
         self.nodes[node_id] = info
         conn.meta["node_id"] = node_id
+        # (re-)registration carries the node's primary object copies so a
+        # restarted GCS rebuilds its object directory
+        for hex_id in payload.get("objects", ()):  # volatile directory state
+            self.object_locations.setdefault(hex_id, set()).add(node_id)
         await self._publish("node_events", {"event": "alive",
                                             "node_id": node_id,
                                             "resources": info.total_resources})
@@ -247,6 +330,7 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return {"added": False}
         self.kv[key] = payload["value"]
+        self.store.put("kv", key, payload["value"])
         return {"added": True}
 
     async def kv_get(self, payload, conn):
@@ -259,9 +343,13 @@ class GcsServer:
             n = 0
             for k in [k for k in self.kv if k.startswith(key)]:
                 del self.kv[k]
+                self.store.delete("kv", k)
                 n += 1
             return {"deleted": n}
-        return {"deleted": int(self.kv.pop(key, None) is not None)}
+        deleted = self.kv.pop(key, None) is not None
+        if deleted:
+            self.store.delete("kv", key)
+        return {"deleted": int(deleted)}
 
     async def kv_keys(self, payload, conn):
         prefix = payload.get("prefix", "")
@@ -275,6 +363,7 @@ class GcsServer:
     async def next_job_id(self, payload, conn):
         idx = self.next_job_index
         self.next_job_index += 1
+        self.store.put("meta", "next_job_index", self.next_job_index)
         return {"job_index": idx}
 
     async def add_job(self, payload, conn):
@@ -286,6 +375,8 @@ class GcsServer:
             "metadata": payload.get("metadata", {}),
             "status": "RUNNING",
         }
+        self.store.put("jobs", payload["job_id"],
+                       self.jobs[payload["job_id"]])
         return {}
 
     async def get_jobs(self, payload, conn):
@@ -320,18 +411,24 @@ class GcsServer:
         RegisterActor persists before dependency resolution so the actor
         survives owner-failure windows; actor_states.rst)."""
         aid = payload["actor_id"]
+        if aid in self.actors:
+            # idempotent under ReconnectingConnection retry: the first
+            # attempt registered before the GCS died mid-reply
+            return {"actor_id": aid, "existing": False}
         name = payload.get("name")
         ns = payload.get("namespace", "")
         if name:
             key = (ns, name)
-            if key in self.named_actors:
+            if key in self.named_actors and self.named_actors[key] != aid:
                 existing = self.named_actors[key]
-                if self.actors.get(existing, {}).get("state") != DEAD:
+                # a mapping whose actor record is missing (crash between
+                # the two persists) counts as DEAD — the name is free
+                if self.actors.get(existing,
+                                   {"state": DEAD}).get("state") != DEAD:
                     if payload.get("get_if_exists"):
                         return {"actor_id": existing, "existing": True}
                     return {"error": f"actor name {name!r} taken in "
                                      f"namespace {ns!r}"}
-            self.named_actors[key] = aid
         self.actors[aid] = {
             "actor_id": aid,
             "name": name,
@@ -349,6 +446,12 @@ class GcsServer:
             "scheduling": payload.get("scheduling", {}),
             "death_cause": None,
         }
+        # actor record first, THEN the name mapping: a crash between the two
+        # fsync points must not leave a name pointing at a missing actor
+        self._persist_actor(aid)
+        if name:
+            self.named_actors[(ns, name)] = aid
+            self.store.put("named_actors", f"{ns}\x00{name}", aid)
         return {"actor_id": aid, "existing": False}
 
     async def create_actor(self, payload, conn):
@@ -361,7 +464,12 @@ class GcsServer:
         info = self.actors.get(aid)
         if info is None:
             return {"error": "unknown actor"}
+        if info["state"] != DEPS_UNREADY:
+            # retried create (GCS restart mid-reply): scheduling is already
+            # in flight or done — kicking it again would lease a 2nd worker
+            return {}
         info["create_spec"] = payload.get("create_spec", info.get("create_spec"))
+        self._persist_actor(aid)
         asyncio.get_running_loop().create_task(self._schedule_actor(aid))
         return {}
 
@@ -400,6 +508,7 @@ class GcsServer:
             info["node_id"] = node_id
             info["worker_address"] = reply["worker_address"]
             info["state"] = ALIVE
+            self._persist_actor(aid)
             await self._publish("actor_events",
                                 {"actor_id": aid, "state": ALIVE,
                                  "worker_address": reply["worker_address"]})
@@ -418,6 +527,7 @@ class GcsServer:
         if max_restarts == -1 or info["num_restarts"] < max_restarts:
             info["num_restarts"] += 1
             info["state"] = RESTARTING
+            self._persist_actor(aid)
             await self._publish("actor_events",
                                 {"actor_id": aid, "state": RESTARTING})
             asyncio.get_running_loop().create_task(self._schedule_actor(aid))
@@ -430,6 +540,7 @@ class GcsServer:
             return
         info["state"] = DEAD
         info["death_cause"] = reason
+        self._persist_actor(aid)
         await self._publish("actor_events",
                             {"actor_id": aid, "state": DEAD, "reason": reason})
         for fut in self._actor_creation_waiters.pop(aid, []):
@@ -457,6 +568,7 @@ class GcsServer:
         node = self.nodes.get(info.get("node_id") or "")
         info["max_restarts"] = 0 if payload.get("no_restart", True) else \
             info["max_restarts"]
+        self._persist_actor(aid)
         if node is not None and info.get("worker_address"):
             try:
                 await node.conn.call("kill_actor_worker", {
@@ -592,6 +704,7 @@ class GcsServer:
                 "strategy": strategy, "assignment": None,
                 "name": payload.get("name"),
             }
+            self._persist_pg(pg_id)
             # retry in background as resources free up
             asyncio.get_running_loop().create_task(
                 self._retry_pg(pg_id))
@@ -604,6 +717,7 @@ class GcsServer:
             "strategy": strategy, "assignment": assignment,
             "name": payload.get("name"),
         }
+        self._persist_pg(pg_id)
         return {"state": "CREATED", "assignment": assignment}
 
     async def _retry_pg(self, pg_id: str):
@@ -621,6 +735,7 @@ class GcsServer:
             if await self._commit_bundles(pg_id, pg["bundles"], assignment):
                 pg["state"] = "CREATED"
                 pg["assignment"] = assignment
+                self._persist_pg(pg_id)
                 await self._publish("pg_events",
                                     {"pg_id": pg_id, "state": "CREATED"})
                 return
@@ -717,6 +832,7 @@ class GcsServer:
         pg = self.placement_groups.pop(payload["pg_id"], None)
         if pg is None:
             return {}
+        self.store.delete("pgs", payload["pg_id"])
         if pg.get("assignment"):
             for idx, nid in enumerate(pg["assignment"]):
                 node = self.nodes.get(nid)

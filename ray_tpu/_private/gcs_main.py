@@ -14,7 +14,9 @@ async def main():
     port = int(os.environ.get("RTPU_GCS_PORT", "0"))
     cfg_json = os.environ.get("RTPU_SYSTEM_CONFIG")
     config = SystemConfig.from_json(cfg_json) if cfg_json else SystemConfig()
-    gcs = GcsServer(config)
+    store_dir = os.environ.get("RTPU_GCS_STORE_DIR") or \
+        os.path.join(session_dir, "gcs_store")
+    gcs = GcsServer(config, store_path=store_dir)
     actual = await gcs.start("127.0.0.1", port)
     tmp = os.path.join(session_dir, ".gcs_port.tmp")
     with open(tmp, "w") as f:

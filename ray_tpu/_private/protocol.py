@@ -198,6 +198,80 @@ async def connect(address: str,
     return Connection(reader, writer, handler=handler, on_close=on_close)
 
 
+class ReconnectingConnection:
+    """A Connection facade that transparently re-dials on failure.
+
+    Used for links to the GCS so a GCS restart (fault tolerance, reference:
+    gcs_rpc_client.h retry semantics) is invisible to raylets and workers:
+    calls made while the GCS is down retry with backoff until
+    `reconnect_timeout_s` elapses; `on_reconnect` (e.g. node re-registration,
+    pubsub re-subscription) runs after each successful re-dial.
+    """
+
+    def __init__(self, address: str, handler=None,
+                 on_reconnect=None, reconnect_timeout_s: float = 30.0):
+        self.address = address
+        self.handler = handler
+        self.on_reconnect = on_reconnect
+        self.reconnect_timeout_s = reconnect_timeout_s
+        self._conn: Optional[Connection] = None
+        self._lock: Optional[asyncio.Lock] = None
+        self.meta: Dict[str, Any] = {}
+
+    async def _ensure(self) -> Connection:
+        if self._conn is not None and not self._conn._closed:
+            return self._conn
+        if self._lock is None:
+            self._lock = asyncio.Lock()
+        async with self._lock:
+            if self._conn is not None and not self._conn._closed:
+                return self._conn
+            deadline = (asyncio.get_running_loop().time()
+                        + self.reconnect_timeout_s)
+            delay = 0.05
+            first = self._conn is None
+            while True:
+                try:
+                    self._conn = await connect(self.address,
+                                               handler=self.handler)
+                    break
+                except OSError:
+                    if asyncio.get_running_loop().time() >= deadline:
+                        raise ConnectionError(
+                            f"cannot reach {self.address}")
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            if not first and self.on_reconnect is not None:
+                await self.on_reconnect(self._conn)
+            return self._conn
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        # Retrying after a mid-call connection loss re-executes the RPC on
+        # the restarted peer, so GCS handlers are written to be idempotent
+        # keyed on caller-supplied unique IDs (actor_id, pg_id, kv key) —
+        # the same contract the reference's gcs_rpc_client retry layer
+        # assumes.
+        attempts = 2
+        for i in range(attempts):
+            conn = await self._ensure()
+            try:
+                return await conn.call(method, payload, timeout=timeout)
+            except ConnectionError:
+                if i == attempts - 1:
+                    raise
+                # peer went away mid-call: reconnect and retry once
+                continue
+
+    async def notify(self, method: str, payload: Any = None):
+        conn = await self._ensure()
+        await conn.notify(method, payload)
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+
+
 class EventLoopThread:
     """A dedicated asyncio loop on a background thread.
 

@@ -238,18 +238,10 @@ class Raylet:
         self.address = f"127.0.0.1:{tcp_port}"
         self.unix_address = f"unix:{sock_path}"
 
-        self.gcs = await protocol.connect(self.gcs_address,
-                                          handler=self._gcs_request)
-        reply = await self.gcs.call("register_node", {
-            "node_id": self.node_id,
-            "raylet_address": self.address,
-            "object_store_path": self.store_path,
-            "resources": self.total_resources,
-            "labels": self.labels,
-            "tpu": self.tpu_info,
-            "hostname": os.uname().nodename,
-            "is_head": self.is_head,
-        })
+        self.gcs = protocol.ReconnectingConnection(
+            self.gcs_address, handler=self._gcs_request,
+            on_reconnect=self._on_gcs_reconnect)
+        reply = await self.gcs.call("register_node", self._register_payload())
         self.config = SystemConfig.from_json(reply["config"])
         loop = asyncio.get_running_loop()
         loop.create_task(self._dispatch_loop())
@@ -261,6 +253,30 @@ class Raylet:
                 loop.create_task(self._start_worker("", ()))
         logger.info("raylet %s up at %s (resources=%s)",
                     self.node_id[:8], self.address, self.total_resources)
+
+    def _register_payload(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "raylet_address": self.address,
+            "object_store_path": self.store_path,
+            "resources": self.total_resources,
+            "labels": self.labels,
+            "tpu": self.tpu_info,
+            "hostname": os.uname().nodename,
+            "is_head": self.is_head,
+            # primary copies held here — lets a restarted GCS rebuild its
+            # object directory (which is not persisted; locations are
+            # node-volatile state, reference: gcs re-subscribes raylets)
+            "objects": [h for h in self.pinned] + list(self.spilled),
+        }
+
+    async def _on_gcs_reconnect(self, conn):
+        """GCS restarted: re-register this node + its object locations."""
+        try:
+            await conn.call("register_node", self._register_payload())
+            logger.info("re-registered with restarted GCS")
+        except Exception as e:
+            logger.warning("GCS re-registration failed: %s", e)
 
     async def _gcs_request(self, method, payload, conn):
         # GCS calls back into us using the same connection
@@ -741,6 +757,10 @@ class Raylet:
 
     async def handle_prepare_bundle(self, payload, conn):
         key = (payload["pg_id"], payload["bundle_index"])
+        # idempotent under GCS-restart retries: this bundle's reservation
+        # already exists — re-deducting would leak resources/chips
+        if key in self.prepared_bundles or key in self.committed_bundles:
+            return {"ok": True}
         res = payload["resources"]
         n_tpu = int(res.get("TPU", 0))
         for k, v in res.items():
@@ -758,6 +778,8 @@ class Raylet:
 
     async def handle_commit_bundle(self, payload, conn):
         key = (payload["pg_id"], payload["bundle_index"])
+        if key in self.committed_bundles:
+            return {"ok": True}  # idempotent retry
         res = self.prepared_bundles.pop(key, None)
         if res is None:
             return {"ok": False}

@@ -387,8 +387,11 @@ class Worker:
         self.io.run(self._server.start_unix(sock))
         self.address = f"unix:{sock}"
         self.gcs_address = gcs_address
-        self.gcs = self.io.run(protocol.connect(
-            gcs_address, handler=self._handle_request))
+        # survives a GCS restart: calls retry after re-dial (GCS fault
+        # tolerance; reference: gcs_rpc_client.h reconnection). The
+        # constructor is loop-free; it dials lazily on first call.
+        self.gcs = protocol.ReconnectingConnection(
+            gcs_address, handler=self._handle_request)
         self.plasma = PlasmaxStore(store_path)
         self.function_manager = FunctionManager(
             lambda m, p: self.io.run(self.gcs.call(m, p)))
@@ -1086,8 +1089,21 @@ class Worker:
             _timeline.record_task(spec.get("fn_name", "task"), _t0,
                                   time.time(), pid=os.getpid(),
                                   failed=app_error)
-        self.try_notify(owner, "task_result", {
-            "task_id": task_hex, "returns": returns, "app_error": app_error})
+        # Deliver the result BEFORE task_done: for TPU tasks the raylet
+        # retires (kills) this worker as soon as task_done arrives, so a
+        # fire-and-forget result here races worker death and the owner would
+        # wait out its full timeout (flaky PG tests, round 3). A drained
+        # notify is on the wire even if we die right after.
+        async def _deliver():
+            conn = await self._peer(owner)
+            await conn.notify("task_result", {
+                "task_id": task_hex, "returns": returns,
+                "app_error": app_error})
+        try:
+            self.io.run(_deliver(), timeout=30)
+        except Exception:
+            logger.warning("result delivery for %s failed", task_hex,
+                           exc_info=True)
         if self.raylet is not None:
             self.io.run_async(self.raylet.call("task_done",
                                                {"task_id": task_hex}))
