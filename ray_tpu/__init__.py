@@ -77,6 +77,7 @@ def init(address: Optional[str] = None, *,
             res["GPU"] = float(num_gpus)
 
         w = Worker()
+        w.log_to_driver = log_to_driver
         if address is None:
             procs = _node_mod.start_head(
                 config, resources=res, labels=labels,

@@ -39,6 +39,7 @@ import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -116,6 +117,8 @@ class WorkerHandle:
         self.idle_since = time.monotonic()
         self.ready = asyncio.get_event_loop().create_future()
         self.num_tasks = 0
+        self.job_id: Optional[str] = None  # last job served (for log routing)
+        self.log_paths: Tuple[str, str] = ("", "")  # (stdout, stderr)
 
 
 class PendingTask:
@@ -202,6 +205,12 @@ class Raylet:
         self._shutdown = False
         self._worker_counter = 0
         self._running_tasks: Dict[str, Tuple[WorkerHandle, PendingTask]] = {}
+        self._oom_killed_workers: Set[str] = set()
+        # content-addressed, shared across sessions on this host (reference:
+        # runtime_env URI cache with refcounting; here cache entries are
+        # immutable-by-hash so no refcounts are needed)
+        self._runtime_env_cache_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu", "runtime_env_cache")
 
     # ----------------------------------------------------------------- wiring
 
@@ -247,6 +256,9 @@ class Raylet:
         loop.create_task(self._dispatch_loop())
         loop.create_task(self._report_loop())
         loop.create_task(self._idle_reaper_loop())
+        loop.create_task(self._log_monitor_loop())
+        if self.config.memory_monitor_enabled:
+            loop.create_task(self._memory_monitor_loop())
         if self.config.prestart_workers:
             n = int(self.total_resources.get("CPU", 1))
             for _ in range(max(1, min(n, 4))):
@@ -293,7 +305,8 @@ class Raylet:
     # ----------------------------------------------------------- worker pool
 
     def _spawn_worker_proc(self, runtime_env: Dict[str, Any],
-                           tpu_chips: Tuple[int, ...]) -> WorkerHandle:
+                           tpu_chips: Tuple[int, ...],
+                           menv=None) -> WorkerHandle:
         self._worker_counter += 1
         worker_id = f"{self.node_id[:8]}-w{self._worker_counter}"
         env = dict(os.environ)
@@ -316,25 +329,68 @@ class Raylet:
             env.pop("PALLAS_AXON_POOL_IPS", None)
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[k] = v
+        # materialized runtime env (pip venv / working_dir / py_modules):
+        # reference analogue: runtime_env_agent.py handing the worker its
+        # context (python exe + env + cwd)
+        python_exe = sys.executable
         cwd = runtime_env.get("working_dir") or None
+        pythonpath: List[str] = []
+        if menv is not None:
+            python_exe = menv.python_exe
+            env.update(menv.env_vars)
+            cwd = menv.cwd or cwd
+            pythonpath.extend(menv.pythonpath)
+        # ray_tpu itself must stay importable when cwd moves away from the
+        # repo (python -m puts cwd first on sys.path)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pythonpath.append(pkg_root)
+        if env.get("PYTHONPATH"):
+            pythonpath.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(pythonpath)
+        if cwd is not None and not os.path.isdir(cwd):
+            cwd = None
         log_base = os.path.join(self.session_dir, "logs")
         os.makedirs(log_base, exist_ok=True)
-        out = open(os.path.join(log_base, f"worker-{worker_id}.out"), "ab")
-        err = open(os.path.join(log_base, f"worker-{worker_id}.err"), "ab")
+        out_path = os.path.join(log_base, f"worker-{worker_id}.out")
+        err_path = os.path.join(log_base, f"worker-{worker_id}.err")
+        out = open(out_path, "ab")
+        err = open(err_path, "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            [python_exe, "-m", "ray_tpu._private.default_worker"],
             env=env, cwd=cwd, stdout=out, stderr=err,
             start_new_session=True)
         handle = WorkerHandle(worker_id, proc,
                               runtime_env_hash=_env_hash(runtime_env),
                               tpu_chips=tpu_chips)
+        handle.log_paths = (out_path, err_path)
         self.workers[worker_id] = handle
         return handle
 
     async def _start_worker(self, env_hash_or_env, tpu_chips) -> WorkerHandle:
         runtime_env = env_hash_or_env if isinstance(env_hash_or_env, dict) \
             else {}
-        handle = self._spawn_worker_proc(runtime_env, tuple(tpu_chips))
+        menv = None
+        if runtime_env and (runtime_env.get("pip")
+                            or runtime_env.get("py_modules")
+                            or str(runtime_env.get("working_dir", ""))
+                            .startswith("gcs://")):
+            from ray_tpu._private import runtime_env as renv
+
+            # materialization does blocking work (venv create, pip install,
+            # unzip) — run it in a thread; KV fetches hop back to the loop
+            loop = asyncio.get_running_loop()
+
+            def _kv_get_sync(key: str):
+                fut = asyncio.run_coroutine_threadsafe(
+                    self.gcs.call("kv_get", {"key": key}), loop)
+                return (fut.result(timeout=60) or {}).get("value")
+
+            menv = await loop.run_in_executor(
+                None, lambda: renv.materialize(
+                    runtime_env, self._runtime_env_cache_dir, _kv_get_sync))
+        handle = self._spawn_worker_proc(runtime_env, tuple(tpu_chips),
+                                         menv=menv)
         try:
             await asyncio.wait_for(handle.ready,
                                    self.config.worker_start_timeout_s)
@@ -380,6 +436,11 @@ class Raylet:
         handle = self.workers.pop(worker_id, None)
         if handle is None:
             return
+        if worker_id in self._oom_killed_workers:
+            self._oom_killed_workers.discard(worker_id)
+            pct = self.config.memory_usage_threshold * 100
+            reason = ("worker killed by the memory monitor: node memory "
+                      f"usage exceeded {pct:.0f}% (OOM protection); {reason}")
         for lst in self.idle_workers.values():
             if handle in lst:
                 lst.remove(handle)
@@ -644,6 +705,7 @@ class Raylet:
                         {"error": "OBJECT_FETCH_FAILED", "message": str(e)})
                 return
         handle.busy_task = ptask.spec["task_id"]
+        handle.job_id = ptask.spec.get("job_id") or handle.job_id
         handle.num_tasks += 1
         self._running_tasks[ptask.spec["task_id"]] = (handle, ptask)
         try:
@@ -729,6 +791,7 @@ class Raylet:
                 lst.remove(handle)
         handle.is_actor = True
         handle.actor_id = payload["actor_id"]
+        handle.job_id = spec.get("job_id")
         handle.tpu_chips = chips
         # busy_task keys the resource release on worker death
         handle.busy_task = "actor-" + payload["actor_id"]
@@ -1066,6 +1129,164 @@ class Raylet:
 
     # ---------------------------------------------------------------- report
 
+    async def _log_monitor_loop(self):
+        """Tail worker stdout/stderr files and publish new lines to the GCS
+        'worker_logs' channel; the driver subscribes and mirrors them, so
+        task/actor print() output appears at the driver.
+
+        Role-equivalent to the reference's log_monitor process
+        (python/ray/_private/log_monitor.py tail → GCS pubsub → driver);
+        here the raylet owns the files, so the tail lives in-process.
+        """
+        # path -> [offset, worker_id, pid, is_err, job_id_getter]
+        tracked: Dict[str, List[Any]] = {}
+        while not self._shutdown:
+            await asyncio.sleep(0.3)
+            for h in list(self.workers.values()):
+                for i, path in enumerate(h.log_paths):
+                    if path and path not in tracked:
+                        tracked[path] = [0, h.worker_id, h.proc.pid,
+                                         i == 1, h]
+            gone = []
+            for path, ent in tracked.items():
+                offset, worker_id, pid, is_err, h = ent
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    gone.append(path)
+                    continue
+                worker_dead = h.worker_id not in self.workers and \
+                    h.proc.poll() is not None
+                if size <= offset:
+                    # drop tails of dead workers once fully drained
+                    if worker_dead:
+                        gone.append(path)
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        data = f.read(256 * 1024)
+                except OSError:
+                    gone.append(path)
+                    continue
+                # consume only up to the last newline so a line mid-write
+                # (or a multi-byte char straddling the chunk) is never torn;
+                # a dead worker's final partial line flushes as-is
+                last_nl = data.rfind(b"\n")
+                if last_nl == -1:
+                    if not worker_dead:
+                        continue
+                elif not worker_dead or last_nl != len(data) - 1:
+                    data = data[:last_nl + 1]
+                ent[0] = offset + len(data)
+                lines = data.decode("utf-8", "replace").splitlines()
+                for start in range(0, len(lines), 200):
+                    try:
+                        await self.gcs.notify("publish", {
+                            "channel": "worker_logs",
+                            "message": {"worker_id": worker_id, "pid": pid,
+                                        "is_err": is_err, "job_id": h.job_id,
+                                        "node_id": self.node_id,
+                                        "lines": lines[start:start + 200]},
+                        })
+                    except Exception:
+                        break
+            for path in gone:
+                tracked.pop(path, None)
+
+    # ------------------------------------------------------- memory monitor
+
+    @staticmethod
+    def _host_memory_fraction() -> float:
+        """Used-memory fraction from /proc/meminfo (cgroup limit if lower).
+
+        Reference: src/ray/common/memory_monitor.h:52 GetMemoryBytes — the
+        min of cgroup and system capacity, usage = total - available."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    info[k] = int(rest.strip().split()[0]) * 1024
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            # cgroup v2 ceiling, when in a container
+            try:
+                with open("/sys/fs/cgroup/memory.max") as f:
+                    raw = f.read().strip()
+                if raw != "max":
+                    limit = int(raw)
+                    if 0 < limit < total:
+                        with open("/sys/fs/cgroup/memory.current") as f:
+                            cur = int(f.read().strip())
+                        # reclaimable page cache must not count as pressure
+                        # (reference: memory_monitor subtracts inactive_file)
+                        try:
+                            with open("/sys/fs/cgroup/memory.stat") as f:
+                                for line in f:
+                                    if line.startswith("inactive_file "):
+                                        cur -= int(line.split()[1])
+                                        break
+                        except OSError:
+                            pass
+                        return max(0, cur) / limit
+            except OSError:
+                pass
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        """Worker-killing policy (reference: worker_killing_policy.h:30
+        RetriableFIFO): prefer workers running retriable tasks, newest
+        first — their work is recoverable via owner retries; then
+        non-retriable tasks; restartable actors; detached/plain actors
+        last."""
+        retriable, tasks, actors = [], [], []
+        for h in self.workers.values():
+            if h.busy_task is None:
+                continue
+            entry = self._running_tasks.get(h.busy_task)
+            if h.is_actor:
+                actors.append(h)
+            elif entry is not None and \
+                    entry[1].spec.get("max_retries", 0) != 0:
+                retriable.append(h)
+            else:
+                tasks.append(h)
+        for group in (retriable, tasks, actors):
+            if group:
+                return max(group, key=lambda h: h.idle_since)
+        return None
+
+    async def _memory_monitor_loop(self):
+        """Kill a worker (policy above) when host memory crosses the
+        threshold, instead of letting the kernel OOM-killer pick a random
+        victim (possibly the raylet or the model actor)."""
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            frac = self._host_memory_fraction()
+            if frac < self.config.memory_usage_threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "memory usage %.1f%% over threshold %.1f%%: killing worker "
+                "%s (task %s) to relieve pressure", frac * 100,
+                self.config.memory_usage_threshold * 100, victim.worker_id,
+                victim.busy_task)
+            self._oom_killed_workers.add(victim.worker_id)
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+            # let the death path run before re-evaluating
+            await asyncio.sleep(period)
+
     async def _report_loop(self):
         while not self._shutdown:
             try:
@@ -1094,8 +1315,5 @@ class Raylet:
 
 
 def _env_hash(runtime_env: Dict[str, Any]) -> str:
-    if not runtime_env:
-        return ""
-    import json
-    return hashlib.sha1(
-        json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:12]
+    from ray_tpu._private.runtime_env import env_hash
+    return env_hash(runtime_env)

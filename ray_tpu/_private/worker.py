@@ -26,6 +26,7 @@ import asyncio
 import logging
 import os
 import queue
+import sys
 import threading
 import time
 import traceback
@@ -349,6 +350,8 @@ class Worker:
         self.reference_counter = ReferenceCounter(self)
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
+        self.log_to_driver = True
+        self._prepared_envs: Dict[str, Any] = {}
         self.task_context = threading.local()
         self._put_counter = 0
         self._put_lock = threading.Lock()
@@ -412,6 +415,15 @@ class Worker:
                 "job_id": self.job_id.hex(), "driver_pid": os.getpid(),
                 "namespace": namespace}))
             self.current_task_id = TaskID.for_driver(self.job_id)
+            if self.log_to_driver:
+                # mirror worker stdout/stderr here (reference: log_monitor
+                # pubsub → driver); re-subscribe after a GCS restart
+                async def _resub(conn):
+                    await conn.call("subscribe",
+                                    {"channels": ["worker_logs"]})
+                self.gcs.on_reconnect = _resub
+                self.io.run(self.gcs.call("subscribe",
+                                          {"channels": ["worker_logs"]}))
         elif job_id is not None:
             self.job_id = job_id
         self.connected = True
@@ -439,7 +451,23 @@ class Worker:
             "borrow_del": self._h_borrow_del,
             "exit_worker": self._h_exit_worker,
             "ping": self._h_ping,
+            "pubsub": self._h_pubsub,
         }
+
+    async def _h_pubsub(self, payload, conn):
+        """GCS pubsub push. Drivers mirror 'worker_logs' lines to their own
+        stdout/stderr (reference: log_monitor → print_logs in worker.py)."""
+        if payload.get("channel") != "worker_logs" or not self.log_to_driver:
+            return {}
+        msg = payload.get("message") or {}
+        job = msg.get("job_id")
+        if job and job != self.job_id.hex():
+            return {}
+        stream = sys.stderr if msg.get("is_err") else sys.stdout
+        prefix = f"({msg.get('worker_id', '?')} pid={msg.get('pid', '?')})"
+        for line in msg.get("lines", ()):
+            print(f"{prefix} {line}", file=stream, flush=True)
+        return {}
 
     async def _handle_request(self, method, payload, conn):
         fn = self._handlers().get(method)
@@ -456,6 +484,35 @@ class Worker:
         with self._peer_lock:
             self._peer_conns[address] = conn
         return conn
+
+    def prepare_runtime_env(self, runtime_env):
+        """Upload local working_dir/py_modules to GCS KV, rewriting the env
+        to content-addressed URIs (reference: packaging.py upload). Cached
+        per env-json so repeated submits don't re-zip."""
+        if not runtime_env:
+            return runtime_env
+        import json as _json
+        from ray_tpu._private import runtime_env as renv
+        # key includes a content fingerprint of local dirs so edits between
+        # submits re-upload (interactive/notebook drivers)
+        prints = []
+        wd = runtime_env.get("working_dir")
+        if isinstance(wd, str) and os.path.isdir(wd):
+            prints.append(renv.dir_fingerprint(wd))
+        for m in runtime_env.get("py_modules") or ():
+            if isinstance(m, str) and os.path.exists(m):
+                prints.append(renv.dir_fingerprint(m))
+        key = _json.dumps([runtime_env, prints], sort_keys=True, default=str)
+        cached = self._prepared_envs.get(key)
+        if cached is not None:
+            return cached
+
+        def _kv_put(k: str, v: bytes):
+            self.call_sync(self.gcs, "kv_put", {"key": k, "value": v})
+
+        prepared = renv.upload_local_paths(runtime_env, _kv_put)
+        self._prepared_envs[key] = prepared
+        return prepared
 
     def try_notify(self, address: str, method: str, payload):
         """Fire-and-forget from any thread."""
@@ -790,8 +847,9 @@ class Worker:
             "arg_refs": arg_refs,
             "num_returns": num_returns,
             "owner_address": self.address,
+            "job_id": self.job_id.hex(),
             "resources": resource_dict_from_options(opts, is_actor=False),
-            "runtime_env": opts.get("runtime_env"),
+            "runtime_env": self.prepare_runtime_env(opts.get("runtime_env")),
             "scheduling": self._scheduling_from_opts(opts),
             "placement_group": self._pg_from_opts(opts),
             "max_retries": opts.get("max_retries",

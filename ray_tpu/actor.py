@@ -220,8 +220,9 @@ class ActorClass:
             "class_name": self._cls.__name__,
             "init_args": ser.to_bytes(),
             "max_concurrency": opts.get("max_concurrency", 1),
-            "runtime_env": opts.get("runtime_env"),
+            "runtime_env": w.prepare_runtime_env(opts.get("runtime_env")),
             "placement_group": pg,
+            "job_id": w.job_id.hex(),
         }
         reg = w.call_sync(w.gcs, "register_actor", {
             "actor_id": actor_id.hex(),

@@ -45,6 +45,11 @@ class SystemConfig:
     idle_worker_kill_s: float = 300.0
     worker_start_timeout_s: float = 60.0
     prestart_workers: bool = True
+    # ---- memory monitor / OOM protection (reference:
+    # src/ray/common/memory_monitor.h + raylet/worker_killing_policy.h) ----
+    memory_monitor_enabled: bool = True
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 500
     # ---- fault tolerance ----
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0

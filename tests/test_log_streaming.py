@@ -1,0 +1,62 @@
+"""Worker log streaming to the driver.
+
+Reference analogue: python/ray/_private/log_monitor.py (tail worker out/err
+→ GCS pubsub → driver stdout) and test_output.py. Here the raylet tails its
+workers' log files and publishes to the 'worker_logs' channel; the driver
+subscribes and mirrors matching lines.
+"""
+
+import time
+
+import ray_tpu
+
+
+def test_task_print_reaches_driver(capfd):
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def shout():
+            print("HELLO-FROM-WORKER-42")
+            return 1
+
+        assert ray_tpu.get(shout.remote(), timeout=60) == 1
+        # the tail->pubsub->driver path is asynchronous; poll the captured fd
+        deadline = time.monotonic() + 15
+        seen = ""
+        while time.monotonic() < deadline:
+            out, _ = capfd.readouterr()
+            seen += out
+            if "HELLO-FROM-WORKER-42" in seen:
+                break
+            time.sleep(0.25)
+        assert "HELLO-FROM-WORKER-42" in seen
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_stderr_reaches_driver(capfd):
+    import sys
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        class Noisy:
+            def speak(self):
+                print("ACTOR-ERR-LINE-7", file=sys.stderr)
+                return "ok"
+
+        a = Noisy.remote()
+        assert ray_tpu.get(a.speak.remote(), timeout=60) == "ok"
+        deadline = time.monotonic() + 15
+        seen = ""
+        while time.monotonic() < deadline:
+            _, err = capfd.readouterr()
+            seen += err
+            if "ACTOR-ERR-LINE-7" in seen:
+                break
+            time.sleep(0.25)
+        assert "ACTOR-ERR-LINE-7" in seen
+    finally:
+        ray_tpu.shutdown()
