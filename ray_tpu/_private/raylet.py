@@ -319,6 +319,18 @@ class Raylet:
         if tpu_chips:
             env[self.config.tpu_visible_chips_env] = ",".join(
                 str(c) for c in tpu_chips)
+            # persistent XLA compilation cache shared across workers and
+            # sessions (SURVEY.md §7 compilation management): first compile
+            # of a program pays once per host, not once per worker process
+            cache = self.config.compilation_cache_dir or os.path.join(
+                tempfile.gettempdir(), "ray_tpu", "xla_cache")
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+            # a driver pinned to CPU (typical: it must not grab libtpu away
+            # from its own workers) passes JAX_PLATFORMS=cpu down the
+            # environment — TPU workers must shed it or they'd never see
+            # their chips
+            if env.get("JAX_PLATFORMS") == "cpu":
+                env.pop("JAX_PLATFORMS")
         else:
             # CPU-only workers must not initialize the TPU plugin: grabbing
             # libtpu would lock the chips away from TPU workers. Force the

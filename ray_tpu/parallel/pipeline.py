@@ -1,0 +1,106 @@
+"""Pipeline parallelism: a microbatched circular-pipeline schedule over the
+``pp`` mesh axis.
+
+No reference analogue (the reference has no pipeline engine — SURVEY.md §2.6
+marks PP absent); this is the TPU-native bar: the schedule is a single XLA
+program — ``shard_map`` manual over ``pp`` (auto/GSPMD over dp/tp/sp inside),
+activations rotate stage-to-stage with ``ppermute`` over ICI, and the
+backward pass falls out of differentiating the forward scan (ppermute has a
+transpose rule; the reverse scan IS the 1B phase, so the schedule is
+GPipe-shaped: M forward ticks, then M backward ticks, bubble 2(S-1)).
+
+Design constraints (standard for stacked-transformer PP):
+  - all stages share one activation shape (uniform blocks);
+  - per-stage parameters are stacked on a leading axis of size S =
+    mesh.shape["pp"], sharded P("pp", ...) so each device group holds its
+    stage's slice;
+  - stage_fn is rematerialized (jax.checkpoint) so the M in-flight
+    microbatch activations, not intermediates, bound memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, mesh, *, axis: str = "pp",
+                   remat: bool = True) -> Callable:
+    """Build ``apply(stage_params, microbatches) -> outputs``.
+
+    stage_fn(params_slice, x) -> y with ``y.shape == x.shape`` — one stage's
+    computation (e.g. L/S transformer blocks).
+    stage_params: pytree whose leaves have leading axis S (stage-stacked).
+    microbatches: [M, mb, ...] array; outputs: [M, mb, ...].
+
+    The circular schedule runs T = M + S - 1 ticks. At tick t, stage 0
+    ingests microbatch t (while it has any); every stage applies its slice
+    and rotates its activation to the next stage. The last stage's outputs
+    for microbatch m emerge at tick m + S - 1 and are broadcast back to all
+    pp groups (psum of a one-hot selection) so downstream (loss) math is
+    replicated over pp.
+    """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def _pipelined(stage_params, microbatches):
+        s_idx = jax.lax.axis_index(axis)
+        size = jax.lax.axis_size(axis)
+        m = microbatches.shape[0]
+        t_total = m + size - 1
+
+        # local stage slice: leading axis is 1 on each pp group — squeeze
+        local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+        def tick(carry, t):
+            buf = carry  # [mb, ...] activation entering this stage
+            inject = microbatches[jnp.clip(t, 0, m - 1)]
+            x_in = jnp.where(s_idx == 0, inject, buf)
+            y = stage_fn(local, x_in)
+            nxt = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % size) for i in range(size)])
+            return nxt, y
+
+        init = jnp.zeros_like(microbatches[0])
+        _, ys = jax.lax.scan(tick, init, jnp.arange(t_total))
+        # outputs for microbatch mb_i leave the LAST stage at tick
+        # mb_i + size - 1; select them and replicate across pp
+        outs = ys[size - 1:]  # [M, mb, ...] (valid only on last stage)
+        # psum in f32: the one-hot selection makes this an exact broadcast,
+        # and XLA-CPU's AllReducePromotion pass miscompiles bf16 all-reduce
+        # (crashes in ChangeOpDataType) — f32 avoids it on every backend
+        dt = outs.dtype
+        is_last = (s_idx == size - 1).astype(jnp.float32)
+        return jax.lax.psum(outs.astype(jnp.float32) * is_last,
+                            axis).astype(dt)
+
+    # manual over pp only; dp/tp/sp remain GSPMD-auto inside — XLA shards
+    # the per-stage math over the other axes exactly as it would un-piped
+    return jax.shard_map(
+        _pipelined, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(),
+        axis_names={axis}, check_vma=False)
+
+
+def stack_stage_params(init_fn: Callable, rngs):
+    """Initialize stage-parameter slices stacked on a leading axis via
+    vmap (one rng per stage; the stage count is len(rngs))."""
+    return jax.vmap(init_fn)(rngs)
+
+
+def sequential_apply(stage_fn: Callable, stage_params, microbatches):
+    """pp=1 semantics: run every stage in order on each microbatch — the
+    parity oracle for tests (same math, no pipeline)."""
+    num_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+    def run_one(x):
+        def body(x, i):
+            p = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+            return stage_fn(p, x), None
+        out, _ = jax.lax.scan(body, x, jnp.arange(num_stages))
+        return out
+    return jax.vmap(run_one)(microbatches)

@@ -34,12 +34,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ray_tpu.parallel.mesh import (MeshSpec, param_sharding)
 
 
-def state_shardings(abstract_state, mesh, spec: MeshSpec):
+def state_shardings(abstract_state, mesh, spec: MeshSpec, override=None):
     """Sharding pytree for an arbitrary train-state pytree.
 
     Optax states (mu/nu of adam) mirror the param tree, so the trailing path
     keys hit the same `param_sharding` rules as the params themselves;
-    scalars (step counts, schedules) replicate.
+    scalars (step counts, schedules) replicate. `override(keys, shape)` may
+    return a NamedSharding to take precedence for special leaves (e.g.
+    stage-stacked pipeline params, expert-stacked MoE params).
     """
     from jax.tree_util import tree_flatten_with_path, tree_unflatten
 
@@ -49,7 +51,10 @@ def state_shardings(abstract_state, mesh, spec: MeshSpec):
         keys = tuple(getattr(p, "key", getattr(p, "idx", str(p)))
                      for p in path)
         shape = getattr(leaf, "shape", ())
-        if len(shape) == 0:
+        special = override(keys, shape) if override is not None else None
+        if special is not None:
+            out.append(special)
+        elif len(shape) == 0:
             out.append(NamedSharding(mesh, P()))
         else:
             out.append(param_sharding(mesh, keys, shape, spec))
@@ -62,6 +67,9 @@ class SpmdTrainer:
 
     init(rng) -> state                (sharded across the mesh)
     step(state, batch) -> state, metrics
+    eval_loss(state, batch) -> loss   (optional; pipelined trainers attach a
+                                       sequential pp=1 oracle here for
+                                       parity checks)
     """
     mesh: Any
     spec: MeshSpec
@@ -69,6 +77,7 @@ class SpmdTrainer:
     step: Callable
     batch_shardings: Any
     state_sharding_tree: Any
+    eval_loss: Optional[Callable] = None
 
 
 def make_causal_lm_trainer(
@@ -201,10 +210,16 @@ def make_image_classifier_trainer(
     repl = NamedSharding(mesh, P())
 
     def train_step(state, batch):
+        img = batch["image"]
+        if img.dtype == jnp.uint8:
+            # uint8 input pipeline (MLPerf-style): ship bytes, normalize
+            # on device — 4x less host->HBM traffic than f32 images
+            img = img.astype(jnp.float32) / 127.5 - 1.0
+
         def loss_fn(p):
             out, mut = model.apply(
                 {"params": p, "batch_stats": state["batch_stats"]},
-                batch["image"], train=True, mutable=["batch_stats"])
+                img, train=True, mutable=["batch_stats"])
             onehot = jax.nn.one_hot(batch["label"], out.shape[-1])
             loss = optax.softmax_cross_entropy(out, onehot).mean()
             return loss, (out, mut["batch_stats"])
@@ -227,6 +242,148 @@ def make_image_classifier_trainer(
     )
     return SpmdTrainer(mesh=mesh, spec=spec, init=init, step=step,
                        batch_shardings=batch_sh, state_sharding_tree=st_sh)
+
+
+def make_pipelined_lm_trainer(
+    model_config,
+    *,
+    mesh,
+    spec: MeshSpec,
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    grad_clip: float = 1.0,
+) -> SpmdTrainer:
+    """Causal-LM trainer with PIPELINE parallelism over the ``pp`` axis.
+
+    Structure: embed (computed outside the pipeline, replicated over pp) →
+    stage-stacked transformer Blocks through the microbatched circular
+    pipeline (parallel/pipeline.py: shard_map manual over pp, ppermute
+    rotation, autodiff backward) → final-LN + untied head. dp shards the
+    per-microbatch batch dim and tp/fsdp shard stage weights as usual —
+    partial-manual shard_map leaves those axes to GSPMD.
+
+    No reference analogue (the reference has no pipeline engine; SURVEY.md
+    §2.6) — this is the TPU-native bar for PP.
+    """
+    import flax.linen as nn
+
+    from ray_tpu.models.gpt2 import Block, causal_lm_loss
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    cfg = model_config
+    n_stages = spec.pp
+    assert cfg.n_layer % n_stages == 0, \
+        f"n_layer={cfg.n_layer} must divide into pp={n_stages} stages"
+    layers_per_stage = cfg.n_layer // n_stages
+
+    class Embed(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            pos = jnp.arange(ids.shape[-1])
+            wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                           name="wte")
+            wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                           name="wpe")
+            return wte(ids) + wpe(pos)
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(layers_per_stage):
+                x = Block(cfg, name=f"h_{i}")(x, deterministic=True)
+            return x
+
+    class Head(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+            return nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                            name="lm_head")(x)
+
+    embed_m, stage_m, head_m = Embed(), Stage(), Head()
+    tx = optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(learning_rate, b1=0.9, b2=0.95,
+                    weight_decay=weight_decay),
+    )
+
+    seq_probe = 8
+
+    def init_fn(rng):
+        r_e, r_s, r_h = jax.random.split(rng, 3)
+        ids = jnp.zeros((1, seq_probe), jnp.int32)
+        x = jnp.zeros((1, seq_probe, cfg.n_embd), cfg.dtype)
+        stage_rngs = jax.random.split(r_s, n_stages)
+        params = {
+            "embed": embed_m.init(r_e, ids)["params"],
+            "stages": jax.vmap(
+                lambda r: stage_m.init(r, x)["params"])(stage_rngs),
+            "head": head_m.init(r_h, x)["params"],
+        }
+        return {"params": params, "opt": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    # shardings: stage-stacked leaves get P("pp", <usual tp/fsdp rule>);
+    # embed/head replicate over pp (their tp/fsdp rules still apply)
+    def _stage_override(keys, shape):
+        if "stages" in keys and len(shape) >= 1:
+            inner = param_sharding(mesh, keys, shape[1:], spec)
+            return NamedSharding(mesh, P("pp", *inner.spec))
+        return None
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    st_sh = state_shardings(abstract, mesh, spec, override=_stage_override)
+    init = jax.jit(init_fn, out_shardings=st_sh)
+
+    # batches arrive pre-microbatched: [M, mb, T]; dp shards mb, sp shards T
+    batch_sh = {
+        "input_ids": NamedSharding(mesh, P(None, ("dp", "fsdp"), "sp")),
+        "labels": NamedSharding(mesh, P(None, ("dp", "fsdp"), "sp")),
+    }
+    repl = NamedSharding(mesh, P())
+    piped = pipeline_apply(
+        lambda p, x: stage_m.apply({"params": p}, x), mesh)
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            x = embed_m.apply({"params": p["embed"]}, batch["input_ids"])
+            y = piped(p["stages"], x.astype(cfg.dtype))
+            logits = head_m.apply({"params": p["head"]}, y)
+            return causal_lm_loss(
+                logits.reshape(-1, logits.shape[-2], logits.shape[-1]),
+                batch["labels"].reshape(-1, batch["labels"].shape[-1]))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt": opt,
+                     "step": state["step"] + 1}
+        return new_state, {"loss": loss,
+                           "grad_norm": optax.global_norm(grads)}
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(st_sh, batch_sh),
+        out_shardings=(st_sh, {"loss": repl, "grad_norm": repl}),
+        donate_argnums=(0,),
+    )
+
+    def eval_loss_fn(state, batch):
+        """pp=1 oracle: the same params through sequential_apply."""
+        from ray_tpu.parallel.pipeline import sequential_apply
+        p = state["params"]
+        x = embed_m.apply({"params": p["embed"]}, batch["input_ids"])
+        y = sequential_apply(
+            lambda sp, xx: stage_m.apply({"params": sp}, xx),
+            p["stages"], x.astype(cfg.dtype))
+        logits = head_m.apply({"params": p["head"]}, y)
+        return causal_lm_loss(
+            logits.reshape(-1, logits.shape[-2], logits.shape[-1]),
+            batch["labels"].reshape(-1, batch["labels"].shape[-1]))
+
+    return SpmdTrainer(mesh=mesh, spec=spec, init=init, step=step,
+                       batch_shardings=batch_sh, state_sharding_tree=st_sh,
+                       eval_loss=jax.jit(eval_loss_fn))
 
 
 def put_batch(trainer: SpmdTrainer, batch: Dict[str, np.ndarray]):

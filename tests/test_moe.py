@@ -1,0 +1,84 @@
+"""MoE / expert-parallel tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.moe import MoE
+
+S, D, FF, E = 32, 16, 32, 4
+
+
+@pytest.fixture
+def moe_and_params():
+    layer = MoE(num_experts=E, d_ff=FF, capacity_factor=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, S // 2, D))
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    return layer, params, x
+
+
+def _oracle(params, x):
+    """Per-token dense evaluation of the same routing decisions."""
+    xf = np.asarray(x, np.float32).reshape(-1, x.shape[-1])
+    rk = np.asarray(params["router"]["kernel"], np.float32)
+    rb = np.asarray(params["router"]["bias"], np.float32)
+    logits = xf @ rk + rb
+    gates = jax.nn.softmax(jnp.asarray(logits), -1)
+    idx = np.argmax(np.asarray(gates), -1)
+    w1 = np.asarray(params["experts_w1"], np.float32)
+    w2 = np.asarray(params["experts_w2"], np.float32)
+    out = np.zeros_like(xf)
+    counts = {e: 0 for e in range(E)}
+    cap = int(2.0 * xf.shape[0] / E)
+    for i, e in enumerate(idx):
+        if counts[e] >= cap:
+            continue  # dropped token -> zero output
+        counts[e] += 1
+        h = np.asarray(jax.nn.gelu(jnp.asarray(xf[i] @ w1[e])))
+        out[i] = (h @ w2[e]) * float(gates[i, e])
+    return out.reshape(x.shape)
+
+
+def test_moe_matches_per_token_oracle(moe_and_params):
+    layer, params, x = moe_and_params
+    y, aux = layer.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(y), _oracle(params, x),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0.0
+
+
+def test_moe_sharded_matches_unsharded(moe_and_params):
+    layer, params, x = moe_and_params
+    want, _ = layer.apply({"params": params}, x)
+
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "ep"))
+    shard = {
+        "router": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+            params["router"]),
+        "experts_w1": jax.device_put(
+            params["experts_w1"], NamedSharding(mesh, P("ep"))),
+        "experts_w2": jax.device_put(
+            params["experts_w2"], NamedSharding(mesh, P("ep"))),
+    }
+    x_sh = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    got, _ = jax.jit(
+        lambda p, xx: layer.apply({"params": p}, xx))(shard, x_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_gradients_flow(moe_and_params):
+    layer, params, x = moe_and_params
+
+    def loss(p):
+        y, aux = layer.apply({"params": p}, x)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    gnorm = float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.sum(b * b), g, 0.0))
+    assert np.isfinite(gnorm) and gnorm > 0.0
