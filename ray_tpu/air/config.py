@@ -63,10 +63,13 @@ class CheckpointConfig:
 @dataclass
 class RunConfig:
     name: Optional[str] = None
-    storage_path: Optional[str] = None
+    storage_path: Optional[str] = None  # local experiment root (local_dir)
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
+    # driver-side experiment callbacks (None = default CSV/JSON/TB loggers)
+    callbacks: Optional[list] = None
+    sync_config: Optional[Any] = None  # tune.syncer.SyncConfig

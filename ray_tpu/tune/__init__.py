@@ -10,10 +10,16 @@ from ray_tpu.tune.sample import (  # noqa: F401
     uniform)
 from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
-    BasicVariantGenerator, ConcurrencyLimiter, RandomSearch, Searcher)
+    BasicVariantGenerator, BayesOptSearch, ConcurrencyLimiter,
+    HyperOptSearch, OptunaSearch, RandomSearch, Searcher, TPESearcher)
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    MedianStoppingRule, PopulationBasedTraining, TrialScheduler)
+    MedianStoppingRule, PB2, PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.logger import (  # noqa: F401
+    Callback, CSVLoggerCallback, JsonLoggerCallback, LoggerCallback,
+    TBXLoggerCallback)
+from ray_tpu.tune.syncer import (  # noqa: F401
+    LocalSyncer, SyncConfig, Syncer, SyncerCallback)
 from ray_tpu.tune.trial import Trial  # noqa: F401
 from ray_tpu.tune.tune import ExperimentAnalysis, TrialRunner, run  # noqa: F401
 from ray_tpu.tune.tuner import (  # noqa: F401

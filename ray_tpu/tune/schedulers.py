@@ -200,3 +200,90 @@ class PopulationBasedTraining(TrialScheduler):
             elif callable(mut):
                 config[key] = mut()
         return config
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: tune/schedulers/pb2.py).
+
+    PBT's exploit step, but exploration picks new hyperparameters by
+    maximizing a GP-UCB acquisition fit to (config → reward improvement)
+    observations from the whole population, instead of random
+    perturbation. The GP is the native one from search/bayesopt.py (the
+    reference wraps GPy, which is not in this image).
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = TRAINING_ITERATION,
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[
+                     Dict[str, List[float]]] = None,
+                 quantile_fraction: float = 0.25,
+                 log_scale_keys: Optional[List[str]] = None,
+                 kappa: float = 2.0,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds: "
+                             "{key: [min, max]}")
+        super().__init__(
+            metric=metric, mode=mode, time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={}, quantile_fraction=quantile_fraction,
+            seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.log_keys = set(log_scale_keys or [])
+        self.kappa = kappa
+        # observations: (warped config vector, normalized reward delta)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._last_metric: Dict[str, float] = {}
+
+    def _warp(self, key: str, v: float) -> float:
+        lo, hi = self.bounds[key]
+        if key in self.log_keys:
+            lo, hi, v = math.log(lo), math.log(hi), math.log(max(v, 1e-12))
+        return min(1.0, max(0.0, (v - lo) / (hi - lo)))
+
+    def _unwarp(self, key: str, u: float) -> float:
+        lo, hi = self.bounds[key]
+        if key in self.log_keys:
+            return math.exp(math.log(lo) + u * (math.log(hi) -
+                                                math.log(lo)))
+        return lo + u * (hi - lo)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        # record the reward delta this config produced since last result
+        if self.metric is not None and self.metric in result:
+            v = float(result[self.metric])
+            if self.mode == "min":
+                v = -v
+            prev = self._last_metric.get(trial.trial_id)
+            self._last_metric[trial.trial_id] = v
+            if prev is not None and all(k in trial.config
+                                        for k in self.bounds):
+                x = [self._warp(k, float(trial.config[k]))
+                     for k in sorted(self.bounds)]
+                self._X.append(x)
+                self._y.append(v - prev)
+        return super().on_trial_result(runner, trial, result)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        keys = sorted(self.bounds)
+        if len(self._y) < 4:
+            for k in keys:
+                config[k] = self._unwarp(k, self._rng.random())
+            return config
+        import numpy as np
+        from ray_tpu.tune.search.bayesopt import GP
+        n_keep = 256  # recent window: the reward landscape is time-varying
+        X = np.asarray(self._X[-n_keep:])
+        y = np.asarray(self._y[-n_keep:])
+        gp = GP(length_scale=0.3)
+        gp.fit(X, y)
+        cand = np.random.default_rng(
+            self._rng.randrange(1 << 31)).random((128, len(keys)))
+        mu, sd = gp.predict(cand)
+        best = cand[int(np.argmax(mu + self.kappa * sd))]
+        for k, u in zip(keys, best):
+            config[k] = self._unwarp(k, float(u))
+        return config

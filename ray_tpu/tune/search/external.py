@@ -1,0 +1,194 @@
+"""Soft-gated adapters for external optimization libraries.
+
+Reference: tune/search/hyperopt/hyperopt_search.py,
+search/optuna/optuna_search.py — both soft-import their backing library.
+Neither ships in this image; when absent these adapters raise an
+ImportError pointing at the native equivalents (TPESearcher /
+BayesOptSearch), which cover the same capability without the dependency.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.sample import resolve
+from ray_tpu.tune.search._space import flatten_space, unflatten
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class HyperOptSearch(Searcher):
+    """hyperopt-backed TPE (requires the `hyperopt` package)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 num_samples: Optional[int] = None,
+                 seed: Optional[int] = None):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires the `hyperopt` package, which is "
+                "not installed. Use ray_tpu.tune.search.TPESearcher — the "
+                "built-in TPE with the same algorithm and no dependency."
+            ) from e
+        super().__init__(metric=metric, mode=mode)
+        from hyperopt import hp, tpe, Trials  # type: ignore
+        self._hp, self._tpe, self._trials_cls = hp, tpe, Trials
+        self._rng = random.Random(seed)
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._space = space
+        self._trials = self._trials_cls()
+        self._live: Dict[str, int] = {}
+
+    def set_search_properties(self, metric, mode, space=None) -> bool:
+        super().set_search_properties(metric, mode, space)
+        if space and self._space is None:
+            self._space = space
+        return True
+
+    def _hp_space(self):
+        from ray_tpu.tune import sample as s
+        dims, consts = flatten_space(self._space)
+        out = {}
+        for d in dims:
+            label = ".".join(d.path)
+            dom = d.domain
+            if isinstance(dom, s.Categorical):
+                out[label] = self._hp.choice(label, dom.categories)
+            elif isinstance(dom, s.LogUniform):
+                import math
+                out[label] = self._hp.loguniform(
+                    label, math.log(dom.lower), math.log(dom.upper))
+            elif isinstance(dom, s.Randint):
+                out[label] = self._hp.randint(label, dom.lower, dom.upper)
+            elif isinstance(dom, s.QUniform):
+                out[label] = self._hp.quniform(
+                    label, dom.lower, dom.upper, dom.q)
+            elif isinstance(dom, s.Normal):
+                out[label] = self._hp.normal(label, dom.mean, dom.sd)
+            else:
+                out[label] = self._hp.uniform(label, dom.lower, dom.upper)
+        return out, consts
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._space is None:
+            raise RuntimeError("HyperOptSearch needs a space")
+        if self.num_samples is not None and \
+                self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        import hyperopt
+        hp_space, consts = self._hp_space()
+        new_ids = self._trials.new_trial_ids(1)
+        self._trials.refresh()
+        docs = self._tpe.suggest(
+            new_ids, hyperopt.base.Domain(lambda c: 0.0, hp_space),
+            self._trials, self._rng.randrange(1 << 31))
+        self._trials.insert_trial_docs(docs)
+        self._trials.refresh()
+        vals = {k: v[0] for k, v in docs[0]["misc"]["vals"].items() if v}
+        self._live[trial_id] = new_ids[0]
+        from ray_tpu.tune import sample as s
+        dims, _ = flatten_space(self._space)
+        by_label = {".".join(d.path): d for d in dims}
+        flat = dict(consts)
+        for label, v in vals.items():
+            dom = by_label[label].domain
+            if isinstance(dom, s.Categorical):
+                # hp.choice stores the chosen INDEX, not the value
+                v = dom.categories[int(v)]
+            flat[tuple(label.split("."))] = v
+        return resolve(unflatten(flat), self._rng)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        import hyperopt
+        tid = self._live.pop(trial_id, None)
+        if tid is None:
+            return
+        for t in self._trials.trials:
+            if t["tid"] != tid:
+                continue
+            if error or not result or self.metric not in result:
+                t["state"] = hyperopt.JOB_STATE_ERROR
+            else:
+                loss = float(result[self.metric])
+                if self.mode == "max":
+                    loss = -loss
+                t["state"] = hyperopt.JOB_STATE_DONE
+                t["result"] = {"loss": loss, "status": "ok"}
+        self._trials.refresh()
+
+
+class OptunaSearch(Searcher):
+    """optuna-backed searcher (requires the `optuna` package)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 num_samples: Optional[int] = None,
+                 seed: Optional[int] = None):
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the `optuna` package, which is not "
+                "installed. Use ray_tpu.tune.search.TPESearcher (TPE, "
+                "optuna's default sampler) or BayesOptSearch instead."
+            ) from e
+        super().__init__(metric=metric, mode=mode)
+        import optuna
+        self._optuna = optuna
+        self._rng = random.Random(seed)
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._space = space
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=seed))
+        self._live: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, space=None) -> bool:
+        super().set_search_properties(metric, mode, space)
+        if space and self._space is None:
+            self._space = space
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._space is None:
+            raise RuntimeError("OptunaSearch needs a space")
+        if self.num_samples is not None and \
+                self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        from ray_tpu.tune import sample as s
+        ot = self._study.ask()
+        dims, consts = flatten_space(self._space)
+        flat = dict(consts)
+        for d in dims:
+            label = ".".join(d.path)
+            dom = d.domain
+            if isinstance(dom, s.Categorical):
+                flat[d.path] = ot.suggest_categorical(label, dom.categories)
+            elif isinstance(dom, s.LogUniform):
+                flat[d.path] = ot.suggest_float(
+                    label, dom.lower, dom.upper, log=True)
+            elif isinstance(dom, s.Randint):
+                flat[d.path] = ot.suggest_int(label, dom.lower,
+                                              dom.upper - 1)
+            elif isinstance(dom, s.QUniform):
+                flat[d.path] = ot.suggest_float(
+                    label, dom.lower, dom.upper, step=dom.q)
+            else:
+                flat[d.path] = ot.suggest_float(label, dom.lower, dom.upper)
+        self._live[trial_id] = ot
+        return resolve(unflatten(flat), self._rng)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ot = self._live.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(ot, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, float(result[self.metric]))

@@ -79,9 +79,17 @@ class Trial:
         self.num_failures = 0
         self.max_failures = max_failures
         self.ckpt_manager = CheckpointManager(num_to_keep, metric, mode)
+        self.logdir: Optional[str] = None  # set by the runner
         # runner-owned handles
         self.actor = None
         self.future = None
+
+    def __getstate__(self):
+        """Snapshot for experiment_state.pkl: drop live handles."""
+        state = self.__dict__.copy()
+        state["actor"] = None
+        state["future"] = None
+        return state
 
     @property
     def last_result(self) -> Optional[Dict[str, Any]]:

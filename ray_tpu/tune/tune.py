@@ -9,6 +9,10 @@ results stream back as object refs, schedulers/searchers see every result.
 
 from __future__ import annotations
 
+import json
+import os
+import pickle
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Type, Union
 
@@ -68,7 +72,8 @@ class TrialRunner:
                  checkpoint_freq: int = 0,
                  num_to_keep: Optional[int] = None,
                  max_failures: int = 0,
-                 callbacks: Optional[List] = None):
+                 callbacks: Optional[List] = None,
+                 local_dir: Optional[str] = None):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
@@ -84,6 +89,90 @@ class TrialRunner:
         self.callbacks = callbacks or []
         self.trials: List[Trial] = []
         self._exhausted = False
+        self.experiment_dir: Optional[str] = None
+        if local_dir is not None:
+            self.experiment_dir = os.path.join(
+                os.path.expanduser(local_dir), experiment_name)
+            os.makedirs(self.experiment_dir, exist_ok=True)
+        for cb in self.callbacks:
+            if hasattr(cb, "setup"):
+                cb.setup(experiment_dir=self.experiment_dir)
+
+    # ------------------------------------------------- experiment state
+
+    def _snapshot(self, force: bool = False):
+        """Persist resumable experiment state (reference:
+        tune/execution/trial_runner.py checkpoint + experiment_state-*.json
+        in the experiment dir). Throttled: trials carry their checkpoint
+        payloads in-memory, so a snapshot can be large — rewriting it on
+        every result would stall the driver."""
+        if self.experiment_dir is None:
+            return
+        now = time.time()
+        period = float(os.environ.get("RTPU_TUNE_SNAPSHOT_PERIOD", "10"))
+        if not force and now - getattr(self, "_last_snapshot", 0.0) < period:
+            return
+        self._last_snapshot = now
+        try:
+            payload = {"trials": self.trials, "exhausted": self._exhausted,
+                       "searcher": self.searcher,
+                       "scheduler": self.scheduler,
+                       "settings": {
+                           "checkpoint_freq": self.checkpoint_freq,
+                           "num_to_keep": self.num_to_keep,
+                           "max_failures": self.max_failures,
+                           "stop": self.stop_criteria,
+                           "metric": self.metric, "mode": self.mode,
+                       },
+                       "timestamp": now}
+            tmp = os.path.join(self.experiment_dir,
+                               ".experiment_state.pkl.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, os.path.join(self.experiment_dir,
+                                         "experiment_state.pkl"))
+        except Exception:
+            return  # unpicklable user objects: skip resumability, not runs
+        summary = [{"trial_id": t.trial_id, "name": t.trial_name,
+                    "status": t.status, "iterations": len(t.results),
+                    "last_result": {
+                        k: v for k, v in (t.last_result or {}).items()
+                        if isinstance(v, (int, float, str, bool))}}
+                   for t in self.trials]
+        with open(os.path.join(self.experiment_dir,
+                               "experiment_state.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+
+    def restore_from_dir(self, experiment_dir: str):
+        """Rebuild trials from a prior run's snapshot; unfinished trials
+        restart from their latest checkpoint."""
+        path = os.path.join(experiment_dir, "experiment_state.pkl")
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        self.trials = payload["trials"]
+        self._exhausted = payload["exhausted"]
+        self.searcher = payload["searcher"]
+        if payload.get("scheduler") is not None:
+            # keep ASHA rungs / PBT scores etc. across the resume
+            self.scheduler = payload["scheduler"]
+        # the original run's settings win over the restoring runner's
+        # defaults (Tuner.restore only knows the path)
+        s = payload.get("settings", {})
+        self.checkpoint_freq = s.get("checkpoint_freq",
+                                     self.checkpoint_freq)
+        self.num_to_keep = s.get("num_to_keep", self.num_to_keep)
+        self.max_failures = s.get("max_failures", self.max_failures)
+        self.stop_criteria = s.get("stop", self.stop_criteria)
+        self.metric = s.get("metric", self.metric)
+        self.mode = s.get("mode", self.mode)
+        for t in self.trials:
+            if t.status in (RUNNING, PENDING):
+                t.status = PENDING
+        # keep new trial names/dirs collision-free across the resume
+        import itertools
+        from ray_tpu.tune import trial as trial_mod
+        maxi = max((t.index for t in self.trials), default=-1)
+        trial_mod._trial_counter = itertools.count(maxi + 1)
 
     # ------------------------------------------------------------- helpers
 
@@ -118,6 +207,10 @@ class TrialRunner:
             trial.ckpt_manager.num_to_keep = self.num_to_keep
             trial.ckpt_manager.metric = self.metric
             trial.ckpt_manager.mode = self.mode
+            if self.experiment_dir is not None:
+                trial.logdir = os.path.join(self.experiment_dir,
+                                            trial.trial_name)
+                os.makedirs(trial.logdir, exist_ok=True)
             self.trials.append(trial)
 
     def _start_trial(self, trial: Trial, checkpoint=None):
@@ -196,9 +289,11 @@ class TrialRunner:
             return
         trial.results.append(result)
         self.searcher.on_trial_result(trial.trial_id, result)
+        # pops the in-band __checkpoint__ payload so loggers see a clean
+        # metrics dict
+        self._save_checkpoint(trial, result)
         for cb in self.callbacks:
             cb.on_trial_result(trial, result)
-        self._save_checkpoint(trial, result)
         if self._should_stop_trial(trial, result):
             # checkpoint-at-end so stop-criteria trials don't finish bare
             if self.checkpoint_freq and not result.get(DONE):
@@ -270,7 +365,9 @@ class TrialRunner:
         for trial in self.trials:
             if trial.status == PENDING and trial.actor is None:
                 try:
-                    self._start_trial(trial)
+                    # resumed trials restart from their latest checkpoint
+                    self._start_trial(trial,
+                                      checkpoint=trial.latest_checkpoint)
                 except Exception as e:
                     self._process_failure(trial, e)
         futures = {t.future: t for t in self._running()
@@ -293,6 +390,11 @@ class TrialRunner:
     def run_all(self):
         while not self.is_finished():
             self.step()
+            self._snapshot()
+        self._snapshot(force=True)
+        for cb in self.callbacks:
+            if hasattr(cb, "on_experiment_end"):
+                cb.on_experiment_end(self.trials)
         return self.trials
 
 
@@ -315,6 +417,9 @@ def run(trainable: Union[Callable, Type[Trainable]],
         max_failures: int = 0,
         name: str = "exp",
         callbacks: Optional[List] = None,
+        local_dir: Optional[str] = None,
+        sync_config=None,
+        resume: bool = False,
         verbose: int = 0) -> "ExperimentAnalysis":
     """The reference's tune.run (tune/tune.py:131)."""
     config = config or {}
@@ -332,6 +437,17 @@ def run(trainable: Union[Callable, Type[Trainable]],
     else:
         search_alg.set_search_properties(metric, mode, config)
 
+    if local_dir is None:
+        local_dir = os.environ.get(
+            "RTPU_RESULTS_DIR", os.path.expanduser("~/ray_tpu_results"))
+    if callbacks is None:
+        from ray_tpu.tune.logger import default_callbacks
+        callbacks = default_callbacks()
+    if sync_config is not None and getattr(
+            sync_config, "upload_dir", None):
+        from ray_tpu.tune.syncer import SyncerCallback
+        callbacks = list(callbacks) + [SyncerCallback(sync_config)]
+
     runner = TrialRunner(
         trainable_cls, search_alg, scheduler,
         experiment_name=name, metric=metric, mode=mode, stop=stop,
@@ -339,7 +455,17 @@ def run(trainable: Union[Callable, Type[Trainable]],
         resources_per_trial=resources_per_trial,
         checkpoint_freq=checkpoint_freq,
         num_to_keep=keep_checkpoints_num,
-        max_failures=max_failures, callbacks=callbacks)
+        max_failures=max_failures, callbacks=callbacks,
+        local_dir=local_dir)
+    if resume:
+        state = os.path.join(runner.experiment_dir or "",
+                             "experiment_state.pkl")
+        if not os.path.exists(state):
+            # a silent fall-through would rerun the whole sweep from
+            # scratch while the caller believes they resumed
+            raise FileNotFoundError(
+                f"resume requested but no experiment state at {state!r}")
+        runner.restore_from_dir(runner.experiment_dir)
     trials = runner.run_all()
     return ExperimentAnalysis(trials, metric=metric, mode=mode)
 

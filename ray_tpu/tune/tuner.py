@@ -77,11 +77,36 @@ class Tuner:
                  *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config=None):
+                 run_config=None,
+                 _resume: bool = False):
         self._trainable = trainable
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config
+        self._resume = _resume
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, type],
+                *, param_space: Optional[Dict[str, Any]] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config=None) -> "Tuner":
+        """Resume an interrupted experiment from its experiment dir
+        (reference: tune/tuner.py Tuner.restore:149). `path` is
+        <local_dir>/<name>; finished trials are kept, unfinished ones
+        restart from their latest checkpoint. The original run's
+        checkpoint/failure/stop settings are restored from the experiment
+        snapshot; pass run_config to supply the non-persisted pieces
+        (callbacks, sync_config)."""
+        import os
+        from ray_tpu.air.config import RunConfig
+        path = os.path.expanduser(path.rstrip("/"))
+        if run_config is None:
+            run_config = RunConfig()
+        run_config.name = os.path.basename(path)
+        run_config.storage_path = os.path.dirname(path)
+        return cls(trainable, param_space=param_space,
+                   tune_config=tune_config, run_config=run_config,
+                   _resume=True)
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -91,9 +116,15 @@ class Tuner:
         checkpoint_freq = 0
         num_to_keep = None
         max_failures = 0
+        local_dir = None
+        callbacks = None
+        sync_config = None
         if rc is not None:
             stop = getattr(rc, "stop", None)
             name = getattr(rc, "name", None) or "exp"
+            local_dir = getattr(rc, "storage_path", None)
+            callbacks = getattr(rc, "callbacks", None)
+            sync_config = getattr(rc, "sync_config", None)
             ckpt_cfg = getattr(rc, "checkpoint_config", None)
             if ckpt_cfg is not None:
                 checkpoint_freq = getattr(
@@ -112,5 +143,7 @@ class Tuner:
             stop=stop, name=name,
             checkpoint_freq=checkpoint_freq,
             keep_checkpoints_num=num_to_keep,
-            max_failures=max_failures)
+            max_failures=max_failures,
+            local_dir=local_dir, callbacks=callbacks,
+            sync_config=sync_config, resume=self._resume)
         return ResultGrid(analysis)

@@ -15,6 +15,12 @@ os.environ["XLA_FLAGS"] = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RTPU_PRESTART_WORKERS", "0")
 
+# Tune writes experiment dirs (loggers + resumable state) to this root by
+# default; keep test runs out of $HOME.
+import tempfile  # noqa: E402
+os.environ.setdefault(
+    "RTPU_RESULTS_DIR", tempfile.mkdtemp(prefix="rtpu_results_"))
+
 # The axon sitecustomize imports jax before this conftest runs, so the env
 # var alone is too late — force the platform through the live config (safe
 # as long as no backend has been initialized yet).
