@@ -36,7 +36,17 @@ _init_lock = threading.Lock()
 _node_processes: Optional[_node_mod.NodeProcesses] = None
 
 
+def _client():
+    """Active ray:// client connection, or None (reference:
+    util/client_connect.py client-mode hooks)."""
+    from ray_tpu.util.client import worker as _cw
+    c = _cw._client
+    return c if (c is not None and c.connected) else None
+
+
 def is_initialized() -> bool:
+    if _client() is not None:
+        return True
     w = _worker_mod._global_worker
     return w is not None and w.connected
 
@@ -60,6 +70,9 @@ def init(address: Optional[str] = None, *,
     with _init_lock:
         if is_initialized():
             if ignore_reinit_error:
+                c = _client()
+                if c is not None:
+                    return dict(c.server_info)
                 return _worker_mod._global_worker.runtime_context
             raise RuntimeError("ray_tpu.init() called twice "
                                "(use ignore_reinit_error=True)")

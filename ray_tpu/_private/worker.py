@@ -1184,7 +1184,10 @@ class Worker:
 
     def _resolve_arg(self, value):
         if isinstance(value, ObjectRef):
-            return self._get_one(value, deadline=None)
+            # bounded: a lost/freed arg object must surface as a task
+            # error, not wedge the executor thread forever
+            deadline = time.monotonic() + self.config.arg_fetch_timeout_s
+            return self._get_one(value, deadline=deadline)
         return value
 
     # -------------------------------------------------------------- actor side

@@ -95,18 +95,25 @@ class ActorHandle:
             seq = self._seq
         task_id = TaskID.for_task(w.current_task_id
                                   or TaskID.for_driver(w.job_id))
-        ser = serialization.serialize((list(args), kwargs))
+        # _serialize_args (not bare serialize): promotes large numpy args
+        # to plasma AND pins contained refs via add_submitted — without the
+        # pin, a temporary like m.remote(put(x)) lets the driver free the
+        # arg object while the call is in flight, and the actor's arg
+        # resolution waits forever on an object that will never reappear
+        # (the un-pinned path wedged every Ape-X/IMPALA weight broadcast)
+        arg_blob, _plasma_deps, arg_refs = w._serialize_args(
+            list(args), kwargs)
         payload = {
             "task_id": task_id.hex(),
             "method": method,
-            "args": ser.to_bytes(),
+            "args": arg_blob,
             "seq": seq,
             "caller": w.address,
         }
         oid = ObjectID.for_return(task_id, 0)
         state = PendingTaskState({"task_id": task_id.hex(),
                                   "fn_name": f"{self._class_name}.{method}",
-                                  "arg_refs": ser.contained_refs},
+                                  "arg_refs": arg_refs},
                                  self._max_task_retries, [oid])
         w.pending_tasks[task_id.hex()] = state
         w.reference_counter.add_owned(oid)
@@ -152,7 +159,14 @@ async def _to_thread(fn, *args):
     return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
 
 
+def _release_submitted_args(w, state: PendingTaskState):
+    for hex_ref, _owner in state.spec.get("arg_refs", []):
+        w.reference_counter.remove_submitted(ObjectID.from_hex(hex_ref))
+    state.spec["arg_refs"] = []
+
+
 def _store_actor_result(w, state: PendingTaskState, ret: Dict[str, Any]):
+    _release_submitted_args(w, state)
     oid = ObjectID.from_hex(ret["object_id"])
     target = state.return_ids[0]
     if ret.get("inline") is not None:
@@ -171,6 +185,7 @@ def _store_actor_result(w, state: PendingTaskState, ret: Dict[str, Any]):
 
 
 def _store_actor_error(w, state: PendingTaskState, e: Exception):
+    _release_submitted_args(w, state)
     payload = serialization.serialize_error(e).to_bytes()
     for oid in state.return_ids:
         w.memory_store.put(oid, payload)
@@ -210,7 +225,32 @@ class ActorClass:
             self._class_key = w.function_manager.export(self._cls, kind="cls")
             self._class_key_mgr = w.function_manager
         actor_id = ActorID.of(w.job_id)
-        ser = serialization.serialize((list(args), kwargs))
+        # same pinning as method calls: Actor.remote(put(x)) must keep x
+        # alive until the constructor has run. Released once the actor
+        # settles (ALIVE or DEAD) — note a later RESTART re-running the
+        # constructor after release relies on lineage reconstruction.
+        arg_blob, _deps, arg_refs = w._serialize_args(list(args), kwargs)
+
+        def _release_ctor_args():
+            if not arg_refs:
+                return
+
+            async def _go():
+                try:
+                    await w.gcs.call(
+                        "wait_actor_alive",
+                        {"actor_id": actor_id.hex(), "timeout": 600},
+                        timeout=610)
+                except Exception:
+                    pass
+                for hex_ref, _owner in arg_refs:
+                    w.reference_counter.remove_submitted(
+                        ObjectID.from_hex(hex_ref))
+            try:
+                w.io.run_async(_go())
+            except Exception:
+                pass
+
         resources = resource_dict_from_options(opts, is_actor=True)
         sched = w._scheduling_from_opts(opts)
         pg = w._pg_from_opts(opts)
@@ -218,7 +258,7 @@ class ActorClass:
             "actor_id": actor_id.hex(),
             "class_key": self._class_key,
             "class_name": self._cls.__name__,
-            "init_args": ser.to_bytes(),
+            "init_args": arg_blob,
             "max_concurrency": opts.get("max_concurrency", 1),
             "runtime_env": w.prepare_runtime_env(opts.get("runtime_env")),
             "placement_group": pg,
@@ -238,12 +278,17 @@ class ActorClass:
             "get_if_exists": opts.get("get_if_exists", False),
             "create_spec": create_spec,
         })
-        if reg.get("error"):
-            raise ValueError(reg["error"])
-        if reg.get("existing"):
+        if reg.get("error") or reg.get("existing"):
+            # no creation will run: drop the constructor-arg pins now
+            for hex_ref, _owner in arg_refs:
+                w.reference_counter.remove_submitted(
+                    ObjectID.from_hex(hex_ref))
+            if reg.get("error"):
+                raise ValueError(reg["error"])
             return get_actor_by_id(reg["actor_id"])
         w.call_sync(w.gcs, "create_actor", {
             "actor_id": actor_id.hex(), "create_spec": create_spec})
+        _release_ctor_args()
         return ActorHandle(actor_id, self._cls.__name__,
                            opts.get("max_task_retries", 0))
 

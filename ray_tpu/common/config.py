@@ -44,6 +44,9 @@ class SystemConfig:
     num_workers_soft_limit: int = -1  # -1: num_cpus
     idle_worker_kill_s: float = 300.0
     worker_start_timeout_s: float = 60.0
+    # how long an executing task waits for an ObjectRef argument before
+    # erroring (a freed/lost arg must not wedge the executor forever)
+    arg_fetch_timeout_s: float = 300.0
     prestart_workers: bool = True
     # ---- memory monitor / OOM protection (reference:
     # src/ray/common/memory_monitor.h + raylet/worker_killing_policy.h) ----

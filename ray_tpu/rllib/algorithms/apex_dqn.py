@@ -1,0 +1,168 @@
+"""Ape-X DQN — distributed prioritized experience replay.
+
+Reference analogue: rllib/algorithms/apex_dqn/apex_dqn.py (Horgan et al.):
+many rollout workers with per-worker exploration epsilons feed a replay
+ACTOR (not a driver-local buffer); the learner pulls prefetched training
+batches from it asynchronously and pushes priority updates back. Here the
+replay shard is a ray_tpu actor, sampling futures are kept in flight for
+both rollout workers and replay sampling, and the per-worker epsilon
+ladder follows the paper: eps_i = base^(1 + i/(N-1) * 7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class _ReplayShard:
+    """Actor wrapping a PrioritizedReplayBuffer (reference:
+    utils/actors.py create_colocated replay actors)."""
+
+    def __init__(self, capacity: int, alpha: float, seed=None):
+        self._buf = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                            seed=seed)
+
+    def add(self, batch: SampleBatch) -> int:
+        self._buf.add(batch)
+        return len(self._buf)
+
+    def sample(self, n: int, beta: float) -> SampleBatch:
+        if len(self._buf) < n:
+            return SampleBatch({})
+        return self._buf.sample(n, beta=beta)
+
+    def update_priorities(self, idx, priorities) -> bool:
+        self._buf.update_priorities(idx, priorities)
+        return True
+
+    def size(self) -> int:
+        return len(self._buf)
+
+
+ReplayShard = ray_tpu.remote(_ReplayShard)
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDQN)
+        self._config.update({
+            "num_workers": 2,
+            "prioritized_replay": True,
+            "epsilon_base": 0.4,  # per-worker ladder: base^(1+7i/(N-1))
+            "replay_prefetch": 2,  # sample futures kept in flight
+            "train_batch_size": 64,
+            "rollout_fragment_length": 16,
+            "learning_starts": 500,
+            "target_network_update_freq": 1000,
+            "max_sample_batches_per_iter": 8,
+            "train_intensity_per_iter": 4,
+        })
+
+
+class ApexDQN(DQN):
+    """DQN with a replay actor between samplers and the learner."""
+
+    _default_config_cls = ApexDQNConfig
+
+    def setup(self, config):
+        super().setup(config)
+        cfg = self.config
+        if not self.workers.remote_workers:
+            raise ValueError("ApexDQN requires num_workers >= 1")
+        self.replay_actor = ReplayShard.remote(
+            cfg["replay_buffer_capacity"],
+            cfg["prioritized_replay_alpha"], cfg.get("seed"))
+        # fixed per-worker epsilon ladder (no annealing — the ladder IS
+        # the exploration schedule in Ape-X)
+        n = len(self.workers.remote_workers)
+        base = cfg.get("epsilon_base", 0.4)
+        for i, w in enumerate(self.workers.remote_workers):
+            eps = base ** (1 + 7 * i / max(1, n - 1))
+            w.set_exploration.remote(exploration_epsilon=eps)
+        self.workers.local_worker.policy.exploration_epsilon = 0.0
+        self._sample_futs: Dict[Any, Any] = {}  # sample fut -> worker
+        self._replay_futs: list = []  # prefetched train-batch futures
+        self._replay_size = 0
+        self._steps_since_target_sync = 0
+        self._learn_count = 0
+
+    def _launch_sample(self, worker):
+        fut = worker.sample.remote()
+        self._sample_futs[fut] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        stats: Dict[str, float] = {}
+        sampled = 0
+
+        for w in self.workers.remote_workers:
+            if w not in self._sample_futs.values():
+                self._launch_sample(w)
+
+        # 1) drain ready rollout batches into the replay actor
+        reaped = 0
+        while reaped < cfg.get("max_sample_batches_per_iter", 8):
+            ready, _ = ray_tpu.wait(list(self._sample_futs),
+                                    num_returns=1, timeout=30.0)
+            if not ready:
+                break
+            fut = ready[0]
+            worker = self._sample_futs.pop(fut)
+            batch = ray_tpu.get(fut)
+            sampled += batch.count
+            # fire-and-forget add; size rides back on the next reap
+            self._replay_size = ray_tpu.get(
+                self.replay_actor.add.remote(batch))
+            worker.set_weights.remote(ray_tpu.put(policy.get_weights()))
+            self._launch_sample(worker)
+            reaped += 1
+        self._timesteps_total += sampled
+
+        # 2) learner: consume prefetched replay samples, refill pipeline
+        if self._replay_size >= cfg["learning_starts"]:
+            beta = cfg["prioritized_replay_beta"]
+            bs = cfg["train_batch_size"]
+            want = cfg.get("train_intensity_per_iter", 4)
+            while len(self._replay_futs) < cfg.get("replay_prefetch", 2):
+                self._replay_futs.append(
+                    self.replay_actor.sample.remote(bs, beta))
+            for _ in range(want):
+                fut = self._replay_futs.pop(0)
+                self._replay_futs.append(
+                    self.replay_actor.sample.remote(bs, beta))
+                train = ray_tpu.get(fut)
+                if train.count == 0:
+                    continue
+                stats = policy.learn_on_batch(train)
+                self._learn_count += 1
+                self.replay_actor.update_priorities.remote(
+                    train["batch_indexes"], stats.pop("td_errors"))
+                self._steps_since_target_sync += train.count
+                if (self._steps_since_target_sync
+                        >= cfg["target_network_update_freq"]):
+                    policy.update_target()
+                    self._steps_since_target_sync = 0
+        stats.pop("td_errors", None)
+        return {
+            "num_env_steps_sampled_this_iter": sampled,
+            "replay_size": self._replay_size,
+            "num_learner_steps": self._learn_count,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def cleanup(self):
+        self._sample_futs.clear()
+        self._replay_futs.clear()
+        try:
+            ray_tpu.kill(self.replay_actor)
+        except Exception:
+            pass
+        super().cleanup()

@@ -1,0 +1,301 @@
+"""DDPG + TD3 — deterministic-policy-gradient continuous control.
+
+Reference analogue: rllib/algorithms/ddpg/ (ddpg.py, ddpg_torch_policy.py)
+and rllib/algorithms/td3.py — in the reference TD3 is a DDPG preset
+(twin_q + delayed policy updates + target policy smoothing); same here.
+TPU-first shape: critic and actor updates are two jitted programs over
+replayed batches; the actor program runs every ``policy_delay`` critic
+steps; polyak target blending rides inside the critic program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.rollout_worker import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class _DDPGNets(nn.Module):
+    act_dim: int
+    twin_q: bool
+    hidden: int = 256
+
+    def setup(self):
+        self.pi_net = nn.Sequential([
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.act_dim), nn.tanh])
+        self.q1_net = nn.Sequential([
+            nn.Dense(self.hidden), nn.relu,
+            nn.Dense(self.hidden), nn.relu, nn.Dense(1)])
+        if self.twin_q:
+            self.q2_net = nn.Sequential([
+                nn.Dense(self.hidden), nn.relu,
+                nn.Dense(self.hidden), nn.relu, nn.Dense(1)])
+
+    def pi(self, obs):
+        return self.pi_net(obs)
+
+    def q(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        q1 = self.q1_net(x)[..., 0]
+        q2 = self.q2_net(x)[..., 0] if self.twin_q else q1
+        return q1, q2
+
+    def __call__(self, obs, act):
+        return self.pi(obs), self.q(obs, act)
+
+
+class DDPGPolicy:
+    """Worker-facing API parity with JaxPolicy (compute_actions /
+    postprocess_trajectory / learn_on_batch / get,set_weights)."""
+
+    def __init__(self, obs_space, action_space, config: Dict[str, Any]):
+        assert isinstance(action_space, Box), "DDPG is continuous-only"
+        self.observation_space = obs_space
+        self.action_space = action_space
+        self.config = config
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32)
+        self.high = np.asarray(action_space.high, np.float32)
+        self.model = _DDPGNets(self.act_dim,
+                               bool(config.get("twin_q", False)))
+        self._rng = jax.random.PRNGKey(config.get("seed") or 0)
+        self._np_rng = np.random.default_rng(config.get("seed"))
+        obs_dim = obs_space.shape or (1,)
+        dummy_o = jnp.zeros((1, *obs_dim), jnp.float32)
+        dummy_a = jnp.zeros((1, self.act_dim), jnp.float32)
+        self.params = self.model.init(self._next_rng(), dummy_o,
+                                      dummy_a)["params"]
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.pi_optimizer = optax.adam(config.get("actor_lr", 1e-3))
+        self.q_optimizer = optax.adam(config.get("critic_lr", 1e-3))
+        self.pi_opt_state = self.pi_optimizer.init(self.params)
+        self.q_opt_state = self.q_optimizer.init(self.params)
+        self._jit_act = jax.jit(self._act_impl)
+        self._jit_critic = jax.jit(self._critic_update)
+        self._jit_actor = jax.jit(self._actor_update)
+        self.global_timestep = 0
+        self._learn_steps = 0
+        # host-side exploration noise scale, annealable via set_exploration
+        self.exploration_noise = config.get("exploration_noise", 0.1)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- inference ----
+
+    def _act_impl(self, params, obs):
+        return self.model.apply({"params": params}, obs,
+                                method=_DDPGNets.pi)
+
+    def compute_actions(self, obs, explore=True):
+        act = np.asarray(self._jit_act(self.params, jnp.asarray(obs)))
+        if explore:
+            act = act + self._np_rng.normal(
+                0.0, self.exploration_noise, act.shape).astype(np.float32)
+            act = np.clip(act, -1.0, 1.0)
+        scaled = self.low + (act + 1.0) * 0.5 * (self.high - self.low)
+        n = len(scaled)
+        return scaled.astype(np.float32), {
+            SampleBatch.ACTION_LOGP: np.zeros(n, np.float32),
+            SampleBatch.VF_PREDS: np.zeros(n, np.float32),
+            "raw_actions": act.astype(np.float32),
+        }
+
+    def postprocess_trajectory(self, batch):
+        return batch
+
+    # ---- learning ----
+
+    def _critic_update(self, params, target_params, q_opt_state, batch,
+                       rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        obs = batch[SampleBatch.OBS]
+        nobs = batch[SampleBatch.NEXT_OBS]
+        acts = batch["raw_actions"]
+        rews = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+
+        next_a = self.model.apply({"params": target_params}, nobs,
+                                  method=_DDPGNets.pi)
+        if cfg.get("smooth_target_policy", False):
+            # TD3 target smoothing: clipped noise on the target action
+            noise = jnp.clip(
+                jax.random.normal(rng, next_a.shape)
+                * cfg.get("target_noise", 0.2),
+                -cfg.get("target_noise_clip", 0.5),
+                cfg.get("target_noise_clip", 0.5))
+            next_a = jnp.clip(next_a + noise, -1.0, 1.0)
+        tq1, tq2 = self.model.apply({"params": target_params}, nobs,
+                                    next_a, method=_DDPGNets.q)
+        target_q = rews + gamma * not_done * jnp.minimum(tq1, tq2)
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def critic_loss_fn(p):
+            q1, q2 = self.model.apply({"params": p}, obs, acts,
+                                      method=_DDPGNets.q)
+            loss = jnp.mean((q1 - target_q) ** 2)
+            if cfg.get("twin_q", False):
+                loss = loss + jnp.mean((q2 - target_q) ** 2)
+            return loss, {"mean_q": jnp.mean(q1),
+                          "mean_td_error": jnp.mean(
+                              jnp.abs(q1 - target_q))}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(params)
+        updates, q_opt_state = self.q_optimizer.update(
+            grads, q_opt_state, params)
+        params = optax.apply_updates(params, updates)
+        tau = cfg.get("tau", 0.005)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+        stats = dict(stats)
+        stats["critic_loss"] = loss_val
+        return params, target_params, q_opt_state, stats
+
+    def _actor_update(self, params, pi_opt_state, batch):
+        obs = batch[SampleBatch.OBS]
+
+        def actor_loss_fn(p):
+            a = self.model.apply({"params": p}, obs, method=_DDPGNets.pi)
+            # gradient flows through the action into Q but must not move
+            # the critic weights (same separation as SAC's actor term)
+            frozen = jax.lax.stop_gradient(p)
+            q1, _ = self.model.apply({"params": frozen}, obs, a,
+                                     method=_DDPGNets.q)
+            return -jnp.mean(q1)
+
+        loss_val, grads = jax.value_and_grad(actor_loss_fn)(params)
+        updates, pi_opt_state = self.pi_optimizer.update(
+            grads, pi_opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, pi_opt_state, loss_val
+
+    def learn_on_batch(self, batch) -> Dict[str, float]:
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if isinstance(v, np.ndarray) and v.dtype != object}
+        (self.params, self.target_params, self.q_opt_state,
+         stats) = self._jit_critic(self.params, self.target_params,
+                                   self.q_opt_state, jbatch,
+                                   self._next_rng())
+        self._learn_steps += 1
+        if self._learn_steps % self.config.get("policy_delay", 1) == 0:
+            self.params, self.pi_opt_state, actor_loss = self._jit_actor(
+                self.params, self.pi_opt_state, jbatch)
+            stats = dict(stats)
+            stats["actor_loss"] = actor_loss
+        self.global_timestep += batch.count
+        return {k: float(v) for k, v in stats.items()}
+
+    def value(self, obs):
+        return np.zeros(len(obs), np.float32)
+
+    def set_exploration(self, **attrs):
+        for k, v in attrs.items():
+            setattr(self, k, v)
+
+    # ---- weights / state ----
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self):
+        return {"weights": self.get_weights(),
+                "target": jax.device_get(self.target_params),
+                "pi_opt_state": jax.device_get(self.pi_opt_state),
+                "q_opt_state": jax.device_get(self.q_opt_state),
+                "global_timestep": self.global_timestep,
+                "learn_steps": self._learn_steps}
+
+    def set_state(self, state):
+        is_np = lambda x: isinstance(x, (np.ndarray, np.generic))
+        self.set_weights(state["weights"])
+        self.target_params = jax.tree_util.tree_map(
+            jnp.asarray, state["target"])
+        self.pi_opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["pi_opt_state"], is_leaf=is_np)
+        self.q_opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["q_opt_state"], is_leaf=is_np)
+        self.global_timestep = state.get("global_timestep", 0)
+        self._learn_steps = state.get("learn_steps", 0)
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self._config.update({
+            "actor_lr": 1e-3,
+            "critic_lr": 1e-3,
+            "tau": 0.005,
+            "twin_q": False,
+            "policy_delay": 1,
+            "smooth_target_policy": False,
+            "target_noise": 0.2,
+            "target_noise_clip": 0.5,
+            "exploration_noise": 0.1,
+            "replay_buffer_capacity": 100_000,
+            "learning_starts": 256,
+            "train_batch_size": 256,
+            "rollout_fragment_length": 1,
+            "training_intensity": 1,
+        })
+
+
+class TD3Config(DDPGConfig):
+    """TD3 = DDPG + twin critics + delayed actor + target smoothing
+    (reference: rllib/algorithms/td3.py)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self._config.update({
+            "twin_q": True,
+            "policy_delay": 2,
+            "smooth_target_policy": True,
+        })
+
+
+class DDPG(Algorithm):
+    _policy_cls = DDPGPolicy
+    _default_config_cls = DDPGConfig
+
+    def setup(self, config):
+        super().setup(config)
+        self.replay = ReplayBuffer(
+            self.config["replay_buffer_capacity"],
+            seed=self.config.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        batch = synchronous_parallel_sample(self.workers)
+        self._timesteps_total += batch.count
+        self.replay.add(batch)
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(max(1, cfg.get("training_intensity", 1))):
+                stats = policy.learn_on_batch(
+                    self.replay.sample(cfg["train_batch_size"]))
+            self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": batch.count,
+                "replay_size": len(self.replay),
+                **{f"learner/{k}": v for k, v in stats.items()}}
+
+
+class TD3(DDPG):
+    _default_config_cls = TD3Config

@@ -96,6 +96,10 @@ class IMPALAConfig(AlgorithmConfig):
             "num_workers": 1,
             "broadcast_interval": 1,
             "max_sample_batches_per_iter": 8,
+            # decoupled learner thread (the defining IMPALA structure);
+            # False falls back to learn-inline-with-reaping
+            "async_learner": True,
+            "learner_queue_size": 16,
         })
 
 
@@ -119,10 +123,25 @@ class IMPALA(Algorithm):
         super().setup(config)
         self._in_flight: Dict[Any, Any] = {}  # future -> worker
         self._learn_count = 0
+        self._learner = None
+        if self.config.get("async_learner", True) and \
+                self.workers.remote_workers:
+            from ray_tpu.rllib.execution import LearnerThread
+            self._learner = LearnerThread(
+                self.workers.local_worker.policy,
+                max_queue_size=self.config.get("learner_queue_size", 16))
+            self._learner.start()
 
     def _launch(self, worker):
         fut = worker.sample.remote()
         self._in_flight[fut] = worker
+
+    def _broadcast_weights(self, worker):
+        if self._learner is not None:
+            weights = self._learner.get_weights()
+        else:
+            weights = self.workers.local_worker.policy.get_weights()
+        worker.set_weights.remote(ray_tpu.put(weights))
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -134,29 +153,45 @@ class IMPALA(Algorithm):
             batch = _mark_cuts(self.workers.local_worker.sample())
             stats = policy.learn_on_batch(batch)
             sampled = batch.count
-        else:
-            for w in self.workers.remote_workers:
-                if w not in self._in_flight.values():
-                    self._launch(w)
-            n_target = cfg.get("max_sample_batches_per_iter", 8)
-            reaped = 0
-            while reaped < n_target:
-                ready, _ = ray_tpu.wait(list(self._in_flight),
-                                        num_returns=1, timeout=60.0)
-                if not ready:
-                    break
-                fut = ready[0]
-                worker = self._in_flight.pop(fut)
-                batch = _mark_cuts(ray_tpu.get(fut))
+            self._timesteps_total += sampled
+            return {
+                "num_env_steps_sampled_this_iter": sampled,
+                **{f"learner/{k}": v for k, v in stats.items()},
+            }
+
+        for w in self.workers.remote_workers:
+            if w not in self._in_flight.values():
+                self._launch(w)
+        n_target = cfg.get("max_sample_batches_per_iter", 8)
+        reaped = 0
+        while reaped < n_target:
+            ready, _ = ray_tpu.wait(list(self._in_flight),
+                                    num_returns=1, timeout=60.0)
+            if not ready:
+                break
+            fut = ready[0]
+            worker = self._in_flight.pop(fut)
+            batch = _mark_cuts(ray_tpu.get(fut))
+            if self._learner is not None:
+                # decoupled: enqueue and keep reaping — sampling overlaps
+                # the device update. A full queue applies backpressure by
+                # blocking here until the learner drains (dropping the
+                # batch would silently lose experience while still
+                # counting it as trained).
+                while not self._learner.put(batch, timeout=5.0):
+                    self._learner.check_error()
+            else:
                 stats = policy.learn_on_batch(batch)
-                sampled += batch.count
-                self._learn_count += 1
-                # async weight push, then relaunch sampling on that actor
-                if self._learn_count % cfg.get("broadcast_interval", 1) == 0:
-                    worker.set_weights.remote(
-                        ray_tpu.put(policy.get_weights()))
-                self._launch(worker)
-                reaped += 1
+            sampled += batch.count
+            self._learn_count += 1
+            if self._learn_count % cfg.get("broadcast_interval", 1) == 0:
+                self._broadcast_weights(worker)
+            self._launch(worker)
+            reaped += 1
+        if self._learner is not None:
+            self._learner.check_error()
+            stats = dict(self._learner.stats)
+            stats.update(self._learner.metrics())
         self._timesteps_total += sampled
         return {
             "num_env_steps_sampled_this_iter": sampled,
@@ -164,5 +199,7 @@ class IMPALA(Algorithm):
         }
 
     def cleanup(self):
+        if self._learner is not None:
+            self._learner.stop()
         self._in_flight.clear()
         super().cleanup()

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.execution.learner_thread import LearnerThread  # noqa: F401

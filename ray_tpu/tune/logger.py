@@ -120,10 +120,11 @@ class CSVLoggerCallback(LoggerCallback):
         # header instead of writing a second one mid-stream
         fieldnames = None
         if os.path.exists(path) and os.path.getsize(path) > 0:
-            with open(path) as existing:
-                header = existing.readline().strip()
-            if header:
-                fieldnames = header.split(",")
+            with open(path, newline="") as existing:
+                try:
+                    fieldnames = next(csv.reader(existing))
+                except StopIteration:
+                    fieldnames = None
         self._files[trial.trial_id] = open(path, "a")
         if fieldnames:
             self._writers[trial.trial_id] = csv.DictWriter(

@@ -265,7 +265,14 @@ class PB2(PopulationBasedTraining):
                      for k in sorted(self.bounds)]
                 self._X.append(x)
                 self._y.append(v - prev)
-        return super().on_trial_result(runner, trial, result)
+        config_before = id(trial.config)
+        decision = super().on_trial_result(runner, trial, result)
+        if id(trial.config) != config_before:
+            # exploit happened: the next result's score jump comes from
+            # the restored checkpoint, not the new config — recording it
+            # as a delta would teach the GP a phantom improvement
+            self._last_metric.pop(trial.trial_id, None)
+        return decision
 
     def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
         keys = sorted(self.bounds)

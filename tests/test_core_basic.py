@@ -183,3 +183,32 @@ def test_nested_tasks(ray_start_shared):
 def test_cluster_resources(ray_start_shared):
     res = ray_tpu.cluster_resources()
     assert res.get("CPU", 0) >= 4
+
+
+def test_actor_call_with_temporary_put_ref(ray_start_shared):
+    """A put() ref passed as an actor-call arg with no other Python
+    reference must stay pinned until the call completes — the un-pinned
+    path freed the object mid-flight and wedged the actor forever
+    (regression: Ape-X/IMPALA weight broadcasts)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.w = None
+
+        def set_w(self, w):
+            self.w = w
+            return float(w.sum())
+
+        def ping(self):
+            return "ok"
+
+    h = Holder.remote()
+    big = np.ones((256, 256), np.float32)  # plasma-sized
+    # temporary ref: dropped by the driver the moment .remote() returns
+    h.set_w.remote(ray_tpu.put(big))
+    # the queued ping only runs if set_w did not wedge the actor
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "ok"
+    assert ray_tpu.get(h.set_w.remote(ray_tpu.put(big * 2)),
+                       timeout=30) == float(big.sum() * 2)

@@ -79,7 +79,7 @@ def test_searcher_num_samples_exhaustion():
 
 
 def test_external_searchers_gated():
-    pytest.importorskip  # documents intent: hyperopt absent in this image
+    # needs hyperopt ABSENT (the point is the gate message)
     try:
         import hyperopt  # noqa: F401
         pytest.skip("hyperopt installed; gate not exercised")
