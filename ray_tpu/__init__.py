@@ -81,6 +81,13 @@ def init(address: Optional[str] = None, *,
             config.update(_system_config)
         if address is None:
             address = os.environ.get("RTPU_ADDRESS")
+        if address and address.startswith("ray://"):
+            # remote driver: everything routes over the client protocol
+            # (reference: ray.init("ray://...") → util/client_connect.py)
+            from ray_tpu.util.client import worker as _cw
+            c = _cw.connect(address[len("ray://"):], namespace=namespace)
+            return {"address": address, "namespace": namespace,
+                    **{k: v for k, v in c.server_info.items()}}
         res: Dict[str, float] = dict(resources or {})
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)
@@ -145,6 +152,10 @@ def _raylet_unix_for(node_info: Dict[str, Any], session_dir: str) -> str:
 
 def shutdown():
     global _node_processes
+    if _client() is not None:
+        from ray_tpu.util.client import worker as _cw
+        _cw.disconnect()
+        return
     w = _worker_mod._global_worker
     if w is not None and w.connected:
         w.disconnect()
@@ -179,11 +190,19 @@ def method(**opts):
 
 
 def put(value: Any) -> ObjectRef:
+    c = _client()
+    if c is not None:
+        return c.put(value)
     return _worker_mod.global_worker().put_object(value)
 
 
 def get(refs: Union[ObjectRef, List[ObjectRef]], *,
         timeout: Optional[float] = None):
+    c = _client()
+    if c is not None:
+        if isinstance(refs, list):
+            return c.get(refs, timeout=timeout)
+        return c.get([refs], timeout=timeout)[0]
     return _worker_mod.get(refs, timeout=timeout)
 
 
@@ -194,30 +213,50 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
         return [], []
     if num_returns > len(refs):
         raise ValueError("num_returns > len(refs)")
+    c = _client()
+    if c is not None:
+        return c.wait(refs, num_returns, timeout)
     return _worker_mod.global_worker().wait(refs, num_returns, timeout)
 
 
-def kill(actor: ActorHandle, *, no_restart: bool = True):
+def kill(actor, *, no_restart: bool = True):
+    c = _client()
+    if c is not None:
+        c.kill_actor(actor._id_hex, no_restart=no_restart)
+        return
     _kill(actor, no_restart=no_restart)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
+    c = _client()
+    if c is not None:
+        c.cancel(ref.hex(), force=force)
+        return
     w = _worker_mod.global_worker()
     w.call_sync(w.raylet, "cancel_task",
                 {"task_id": ref.id().task_id().hex(), "force": force})
 
 
 def cluster_resources() -> Dict[str, float]:
+    c = _client()
+    if c is not None:
+        return c.cluster_info("cluster_resources")
     w = _worker_mod.global_worker()
     return w.call_sync(w.gcs, "cluster_resources", {})
 
 
 def available_resources() -> Dict[str, float]:
+    c = _client()
+    if c is not None:
+        return c.cluster_info("available_resources")
     w = _worker_mod.global_worker()
     return w.call_sync(w.gcs, "available_resources", {})
 
 
 def nodes() -> List[Dict[str, Any]]:
+    c = _client()
+    if c is not None:
+        return c.cluster_info("nodes")
     w = _worker_mod.global_worker()
     return w.call_sync(w.gcs, "get_nodes", {})
 

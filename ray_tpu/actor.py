@@ -219,6 +219,10 @@ class ActorClass:
         return ClassNode(self, args, kwargs, self._default_opts)
 
     def _create(self, opts: Dict[str, Any], args, kwargs) -> ActorHandle:
+        from ray_tpu.util.client.worker import client_mode
+        c = client_mode()
+        if c is not None and c.connected:
+            return c.create_actor(self._cls, args, kwargs, opts)
         w = global_worker()
         if self._class_key is None or \
                 self._class_key_mgr is not w.function_manager:
@@ -286,8 +290,14 @@ class ActorClass:
             if reg.get("error"):
                 raise ValueError(reg["error"])
             return get_actor_by_id(reg["actor_id"])
-        w.call_sync(w.gcs, "create_actor", {
-            "actor_id": actor_id.hex(), "create_spec": create_spec})
+        try:
+            w.call_sync(w.gcs, "create_actor", {
+                "actor_id": actor_id.hex(), "create_spec": create_spec})
+        except BaseException:
+            for hex_ref, _owner in arg_refs:
+                w.reference_counter.remove_submitted(
+                    ObjectID.from_hex(hex_ref))
+            raise
         _release_ctor_args()
         return ActorHandle(actor_id, self._cls.__name__,
                            opts.get("max_task_retries", 0))
@@ -307,6 +317,10 @@ class _BoundActorClass:
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    from ray_tpu.util.client.worker import client_mode
+    c = client_mode()
+    if c is not None and c.connected:
+        return c.get_named_actor(name, namespace)
     w = global_worker()
     info = w.call_sync(w.gcs, "get_named_actor", {
         "name": name, "namespace": namespace if namespace is not None

@@ -41,6 +41,10 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs, self._default_opts)
 
     def _remote(self, args, kwargs, opts: Dict[str, Any]):
+        from ray_tpu.util.client.worker import client_mode
+        c = client_mode()
+        if c is not None and c.connected:
+            return c.submit_fn(self._fn, args, kwargs, opts)
         w = global_worker()
         if self._fn_key is None or self._fn_key_mgr is not w.function_manager:
             # re-export after a cluster restart: the key cache is only

@@ -118,12 +118,14 @@ class ApexDQN(DQN):
             worker = self._sample_futs.pop(fut)
             batch = ray_tpu.get(fut)
             sampled += batch.count
-            # fire-and-forget add; size rides back on the next reap
-            self._replay_size = ray_tpu.get(
-                self.replay_actor.add.remote(batch))
+            # non-blocking add: only the LAST size future is collected
+            # after the drain loop (one round trip per step, not per reap)
+            add_fut = self.replay_actor.add.remote(batch)
             worker.set_weights.remote(ray_tpu.put(policy.get_weights()))
             self._launch_sample(worker)
             reaped += 1
+        if reaped:
+            self._replay_size = ray_tpu.get(add_fut)
         self._timesteps_total += sampled
 
         # 2) learner: consume prefetched replay samples, refill pipeline

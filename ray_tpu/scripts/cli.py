@@ -32,6 +32,17 @@ def cmd_start(args):
             if args.resources else None)
         print(f"started head; GCS at {ctx['gcs_address']}")
         print(f"export RTPU_ADDRESS={ctx['gcs_address']}")
+        if args.ray_client_server_port is not None:
+            from ray_tpu.util.client.server import ClientServer
+            srv = ClientServer(port=args.ray_client_server_port)
+            print(f"ray:// client server on port {srv.port} "
+                  f"(connect with ray_tpu.init('ray://<host>:{srv.port}'))")
+            if not args.block:
+                # the server lives on daemon threads in THIS process; if
+                # the CLI exits, clients get connection-refused while the
+                # cluster subprocesses keep running
+                print("note: --ray-client-server-port implies --block")
+                args.block = True
         if args.dashboard:
             from ray_tpu.dashboard.dashboard import start_dashboard
             port = start_dashboard(port=args.dashboard_port)
@@ -152,6 +163,8 @@ def main(argv=None):
     sp.add_argument("--resources", help="JSON dict of extra resources")
     sp.add_argument("--dashboard", action="store_true")
     sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--ray-client-server-port", type=int, default=None,
+                    help="serve ray:// clients on this port (0 = pick)")
     sp.add_argument("--block", action="store_true")
     sp.set_defaults(func=cmd_start)
 

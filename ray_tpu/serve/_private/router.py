@@ -126,6 +126,17 @@ class Router:
             raise KeyError(f"unknown deployment {deployment_name!r}")
         return s
 
+    def force_refresh(self):
+        """Synchronous pull of the current route table (bypasses the
+        long-poll latency) — used after a deploy barrier or when a
+        request hits a dead replica."""
+        try:
+            _, snapshot = ray_tpu.get(
+                self._controller.get_route_table.remote(), timeout=10.0)
+            self._on_update(snapshot)
+        except Exception:
+            pass
+
     def assign_request(self, deployment_name: str, method_name: str,
                        args: tuple, kwargs: dict):
         """Pick a replica, fire the call, return (ObjectRef, done_cb)."""

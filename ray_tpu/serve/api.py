@@ -166,6 +166,15 @@ def run(app: Union[Application, Deployment], *,
                 timeout=60.0)
     _wait_healthy(controller, [s["name"] for s in specs],
                   timeout=_blocking_timeout)
+    if http_port is not None:
+        # route barrier: the proxy must be on the post-deploy table
+        # before run() returns, or an immediate request can match the
+        # previous app's routes (and its torn-down replicas)
+        try:
+            proxy = ray_tpu.get_actor("SERVE_PROXY")
+            ray_tpu.get(proxy.sync_routes.remote(), timeout=30.0)
+        except ValueError:
+            pass
     return DeploymentHandle(root_name, controller)
 
 

@@ -27,6 +27,10 @@ class _DeploymentInfo:
         self.target_replicas = config.get("num_replicas", 1)
         # actor handle -> version string
         self.replicas: Dict[Any, str] = {}
+        # handles confirmed ready (first successful check_health) —
+        # HEALTHY counts these, not mere creations, so serve.run cannot
+        # return while replicas are still constructing
+        self.ready: set = set()
         self.autoscaler = None
         autoscale = config.get("autoscaling_config")
         if autoscale:
@@ -66,6 +70,7 @@ class ServeController:
                 info = _DeploymentInfo(d)
                 if existing is not None:
                     info.replicas = existing.replicas
+                    info.ready = existing.ready
                 self._deployments[d["name"]] = info
             for stale in set(self._deployments) - new_names:
                 self._deployments[stale].target_replicas = 0
@@ -93,7 +98,7 @@ class ServeController:
             for name, info in self._deployments.items():
                 if info.config.get("_deleted"):
                     continue
-                n_live = len(info.replicas)
+                n_live = sum(1 for h in info.replicas if h in info.ready)
                 out[name] = {
                     "name": name,
                     "status": ("HEALTHY"
@@ -187,6 +192,7 @@ class ServeController:
                 for h in stale:
                     self._stop_replica(h)
                     del info.replicas[h]
+                    info.ready.discard(h)
                     changed = True
                 delta = info.target_replicas - len(info.replicas)
                 for _ in range(max(0, delta)):
@@ -196,6 +202,7 @@ class ServeController:
                     h = next(iter(info.replicas))
                     self._stop_replica(h)
                     del info.replicas[h]
+                    info.ready.discard(h)
                     changed = True
                 if info.config.get("_deleted") and not info.replicas:
                     del self._deployments[name]
@@ -228,12 +235,20 @@ class ServeController:
             for h in handles:
                 try:
                     ray_tpu.get(h.check_health.remote(), timeout=10.0)
+                    if h not in info.ready:
+                        with self._lock:
+                            info.ready.add(h)
                 except Exception:
                     dead.append((info, h))
         if dead:
             with self._lock:
                 for info, h in dead:
                     info.replicas.pop(h, None)
+                    info.ready.discard(h)
+            # routers must stop picking the dead replicas NOW — the next
+            # reconcile replaces them, but the table with them removed
+            # has to go out immediately
+            self._publish_route_table()
             self._reconcile_once()
 
     def _autoscale_tick(self):

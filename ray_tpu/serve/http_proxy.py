@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
@@ -88,17 +89,47 @@ class HTTPProxyActor:
                     q = parse_qs(parsed.query)
                     payload = {k: v[0] if len(v) == 1 else v
                                for k, v in q.items()} if q else None
-                try:
-                    ref, release = proxy._router.assign_request(
-                        name, "__call__",
-                        (payload,) if payload is not None else (), {})
+                from ray_tpu import exceptions as rexc
+                last_err: Optional[Exception] = None
+                # only idempotent requests are retried — a POST may have
+                # run side effects on the replica before it died
+                attempts = 4 if self.command == "GET" else 1
+                for attempt in range(attempts):
                     try:
-                        result = ray_tpu.get(ref, timeout=60.0)
-                    finally:
-                        release()
-                    self._respond(200, result)
-                except Exception as e:
-                    self._respond(500, {"error": repr(e)})
+                        ref, release = proxy._router.assign_request(
+                            name, "__call__",
+                            (payload,) if payload is not None else (), {})
+                        try:
+                            result = ray_tpu.get(ref, timeout=60.0)
+                        finally:
+                            release()
+                        self._respond(200, result)
+                        return
+                    except (rexc.ActorDiedError,
+                            rexc.ActorUnavailableError) as e:
+                        # routed to a replica that died (e.g. torn down by
+                        # a redeploy the long-poll hasn't delivered yet):
+                        # resync membership and retry
+                        last_err = e
+                        time.sleep(0.3 * (attempt + 1))
+                        proxy._router.force_refresh()
+                        proxy._refresh_routes()
+                        fresh = proxy._match(parsed.path)
+                        if fresh is None:
+                            break
+                        name = fresh
+                    except Exception as e:
+                        self._respond(500, {"error": repr(e)})
+                        return
+                if attempts == 1:
+                    # resync for the NEXT request, surface a retryable
+                    # status for this one
+                    proxy._router.force_refresh()
+                    proxy._refresh_routes()
+                    self._respond(503, {"error": repr(last_err),
+                                        "retryable": True})
+                else:
+                    self._respond(500, {"error": repr(last_err)})
 
             def _respond(self, code: int, result: Any):
                 try:
@@ -132,6 +163,14 @@ class HTTPProxyActor:
             raise RuntimeError("no free port for HTTP proxy")
         threading.Thread(target=self._server.serve_forever,
                          daemon=True).start()
+
+    def sync_routes(self) -> bool:
+        """Synchronously pull the current route table + replica sets —
+        the deploy barrier serve.run uses so a request right after it
+        returns cannot see pre-deploy routing."""
+        self._refresh_routes()
+        self._router.force_refresh()
+        return True
 
     def get_port(self) -> int:
         return self.port

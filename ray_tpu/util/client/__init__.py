@@ -1,0 +1,13 @@
+"""ray:// remote connectivity (reference: python/ray/util/client/).
+
+Usage: ``ray_tpu.init("ray://head-host:10001")`` on any machine that can
+reach the head; the public API (remote/get/put/wait/actors) then routes
+over the client protocol. Server side: ``ClientServer`` in a process with
+a real driver connection (``ray-tpu start --head --ray-client-server-port
+10001`` starts one).
+"""
+
+from ray_tpu.util.client.common import (  # noqa: F401
+    ClientActorHandle, ClientObjectRef)
+from ray_tpu.util.client.worker import (  # noqa: F401
+    ClientWorker, client_mode, connect, disconnect)

@@ -1,0 +1,288 @@
+"""Client server: hosts ray:// connections inside a cluster process.
+
+Reference analogue: python/ray/util/client/server/server.py (RayletServicer)
++ server/proxier.py. This server runs in a process that has a real driver
+connection (ray_tpu.init() already done — e.g. the head started with
+``ray-tpu start --head --ray-client-server-port``); each client connection
+gets its own table of real ObjectRefs/ActorHandles, freed wholesale on
+disconnect. Client payloads are cloudpickle; real refs embedded in results
+are swapped for client refs at serialization time (reducer_override) and
+back at deserialization time (common._server_resolver).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu._private import protocol, worker as worker_mod
+from ray_tpu.util.client import common as client_common
+
+
+class _ConnTable:
+    """Per-connection real-object tables (the server-side ownership)."""
+
+    def __init__(self):
+        self.refs: Dict[str, Any] = {}  # ref hex -> real ObjectRef
+        self.actors: Dict[str, Any] = {}  # actor hex -> real ActorHandle
+
+    def track_ref(self, ref) -> str:
+        h = ref.hex()
+        self.refs[h] = ref
+        return h
+
+    def resolve_ref(self, ref_hex: str):
+        try:
+            return self.refs[ref_hex]
+        except KeyError:
+            raise KeyError(f"unknown client ref {ref_hex[:16]} "
+                           "(already released?)")
+
+    def track_actor(self, handle) -> str:
+        h = handle._id_hex
+        self.actors[h] = handle
+        return h
+
+    def resolve_actor(self, actor_hex: str):
+        try:
+            return self.actors[actor_hex]
+        except KeyError:
+            raise KeyError(f"unknown client actor {actor_hex[:16]}")
+
+
+class _ServerPickler(cloudpickle.CloudPickler):
+    """Swaps real ObjectRefs/ActorHandles in outgoing values for client
+    handles, registering them in the connection table on the way out."""
+
+    def __init__(self, file, table: _ConnTable, **kw):
+        super().__init__(file, **kw)
+        self._table = table
+
+    def reducer_override(self, obj):
+        from ray_tpu._private.worker import ObjectRef
+        from ray_tpu.actor import ActorHandle
+        if isinstance(obj, ObjectRef):
+            self._table.track_ref(obj)
+            return (client_common._rehydrate_ref, (obj.hex(),))
+        if isinstance(obj, ActorHandle):
+            self._table.track_actor(obj)
+            return (client_common._rehydrate_actor,
+                    (obj._id_hex, obj._class_name))
+        return NotImplemented
+
+
+def _server_dumps(value: Any, table: _ConnTable) -> bytes:
+    buf = io.BytesIO()
+    _ServerPickler(buf, table, protocol=pickle.HIGHEST_PROTOCOL).dump(value)
+    return buf.getvalue()
+
+
+def _server_loads(data: bytes, table: _ConnTable) -> Any:
+    client_common._server_resolver.table = table
+    try:
+        return cloudpickle.loads(data)
+    finally:
+        client_common._server_resolver.table = None
+
+
+class ClientServer:
+    """Serves ray:// clients on a TCP port. Blocking cluster calls run on
+    a per-server executor thread pool so the protocol loop stays live."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        if not ray_tpu.is_initialized():
+            raise RuntimeError("ClientServer requires ray_tpu.init() first")
+        from concurrent.futures import ThreadPoolExecutor
+        self._exec = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="ray-client-srv")
+        self._exports: Dict[str, Any] = {}  # sha -> deserialized fn/cls
+        self._io = protocol.EventLoopThread("ray-client-server")
+        self._server = protocol.Server(self._handlers())
+        self.port = self._io.run(self._server.start_tcp(host, port))
+
+    # every handler: payload, conn -> result (async); blocking work hops
+    # to the executor
+    def _handlers(self):
+        async def _run(fn, *args):
+            import asyncio
+            return await asyncio.get_running_loop().run_in_executor(
+                self._exec, fn, *args)
+
+        def table(conn) -> _ConnTable:
+            t = conn.meta.get("client_table")
+            if t is None:
+                t = conn.meta["client_table"] = _ConnTable()
+            return t
+
+        async def client_hello(payload, conn):
+            table(conn)
+            return {"version": ray_tpu.__version__,
+                    "namespace": payload.get("namespace", "")}
+
+        async def client_put(payload, conn):
+            t = table(conn)
+
+            def _do():
+                value = _server_loads(payload["data"], t)
+                return t.track_ref(ray_tpu.put(value))
+            return await _run(_do)
+
+        async def client_get(payload, conn):
+            t = table(conn)
+
+            def _do():
+                out = []
+                for h in payload["ids"]:
+                    try:
+                        ref = t.resolve_ref(h)
+                        value = ray_tpu.get(ref,
+                                            timeout=payload.get("timeout"))
+                        out.append({"data": _server_dumps(value, t),
+                                    "error": None})
+                    except BaseException as e:  # ships to the client
+                        out.append({"data": None,
+                                    "error": cloudpickle.dumps(e)})
+                return out
+            return await _run(_do)
+
+        async def client_wait(payload, conn):
+            t = table(conn)
+
+            def _do():
+                refs = [t.resolve_ref(h) for h in payload["ids"]]
+                ready, not_ready = ray_tpu.wait(
+                    refs, num_returns=payload["num_returns"],
+                    timeout=payload.get("timeout"))
+                return {"ready": [r.hex() for r in ready],
+                        "not_ready": [r.hex() for r in not_ready]}
+            return await _run(_do)
+
+        async def client_release(payload, conn):
+            t = table(conn)
+            for h in payload.get("ids", []):
+                t.refs.pop(h, None)
+
+        async def client_export(payload, conn):
+            def _do():
+                sha = hashlib.sha256(payload["data"]).hexdigest()[:32]
+                if sha not in self._exports:
+                    self._exports[sha] = (cloudpickle.loads(payload["data"]),
+                                          payload.get("kind", "fn"))
+                return sha
+            return await _run(_do)
+
+        async def client_task(payload, conn):
+            t = table(conn)
+
+            def _do():
+                fn, _ = self._exports[payload["key"]]
+                args, kwargs = _server_loads(payload["args"], t)
+                opts = payload.get("opts") or {}
+                rf = ray_tpu.remote(fn) if not opts else \
+                    ray_tpu.remote(**opts)(fn)
+                refs = rf.remote(*args, **kwargs)
+                if not isinstance(refs, list):
+                    refs = [refs]
+                return [t.track_ref(r) for r in refs]
+            return await _run(_do)
+
+        async def client_actor_create(payload, conn):
+            t = table(conn)
+
+            def _do():
+                cls, _ = self._exports[payload["key"]]
+                args, kwargs = _server_loads(payload["args"], t)
+                opts = payload.get("opts") or {}
+                ac = ray_tpu.remote(cls) if not opts else \
+                    ray_tpu.remote(**opts)(cls)
+                handle = ac.remote(*args, **kwargs)
+                return t.track_actor(handle)
+            return await _run(_do)
+
+        async def client_actor_call(payload, conn):
+            t = table(conn)
+
+            def _do():
+                handle = t.resolve_actor(payload["actor_id"])
+                args, kwargs = _server_loads(payload["args"], t)
+                method = getattr(handle, payload["method"])
+                return t.track_ref(method.remote(*args, **kwargs))
+            return await _run(_do)
+
+        async def client_cancel(payload, conn):
+            t = table(conn)
+
+            def _do():
+                ref = t.resolve_ref(payload["id"])
+                ray_tpu.cancel(ref, force=payload.get("force", False))
+                return True
+            return await _run(_do)
+
+        async def client_actor_kill(payload, conn):
+            t = table(conn)
+
+            def _do():
+                handle = t.resolve_actor(payload["actor_id"])
+                ray_tpu.kill(handle,
+                             no_restart=payload.get("no_restart", True))
+                return True
+            return await _run(_do)
+
+        async def client_get_actor(payload, conn):
+            t = table(conn)
+
+            def _do():
+                try:
+                    handle = ray_tpu.get_actor(
+                        payload["name"],
+                        namespace=payload.get("namespace"))
+                except ValueError as e:
+                    return {"error": str(e)}
+                return {"actor_id": t.track_actor(handle),
+                        "class_name": handle._class_name}
+            return await _run(_do)
+
+        async def client_cluster_info(payload, conn):
+            def _do():
+                kind = payload["kind"]
+                if kind == "cluster_resources":
+                    return ray_tpu.cluster_resources()
+                if kind == "available_resources":
+                    return ray_tpu.available_resources()
+                if kind == "nodes":
+                    return ray_tpu.nodes()
+                raise ValueError(f"unknown cluster info kind {kind!r}")
+            return await _run(_do)
+
+        async def _on_disconnect(conn):
+            # wholesale release of the client's refs (owner-side GC kicks
+            # in when the table entries drop)
+            conn.meta.pop("client_table", None)
+
+        return {
+            "client_hello": client_hello,
+            "client_put": client_put,
+            "client_get": client_get,
+            "client_wait": client_wait,
+            "client_release": client_release,
+            "client_export": client_export,
+            "client_task": client_task,
+            "client_cancel": client_cancel,
+            "client_actor_create": client_actor_create,
+            "client_actor_call": client_actor_call,
+            "client_actor_kill": client_actor_kill,
+            "client_get_actor": client_get_actor,
+            "client_cluster_info": client_cluster_info,
+            "_on_disconnect": _on_disconnect,
+        }
+
+    def stop(self):
+        self._server.close()
+        self._io.stop()
+        self._exec.shutdown(wait=False)
