@@ -109,6 +109,7 @@ class ApexDQN(DQN):
 
         # 1) drain ready rollout batches into the replay actor
         reaped = 0
+        add_futs = []
         while reaped < cfg.get("max_sample_batches_per_iter", 8):
             ready, _ = ray_tpu.wait(list(self._sample_futs),
                                     num_returns=1, timeout=30.0)
@@ -118,14 +119,15 @@ class ApexDQN(DQN):
             worker = self._sample_futs.pop(fut)
             batch = ray_tpu.get(fut)
             sampled += batch.count
-            # non-blocking add: only the LAST size future is collected
-            # after the drain loop (one round trip per step, not per reap)
-            add_fut = self.replay_actor.add.remote(batch)
+            # non-blocking adds; ALL are collected after the drain loop
+            # (one blocking round per step, and an add failure still
+            # surfaces instead of being dropped unawaited)
+            add_futs.append(self.replay_actor.add.remote(batch))
             worker.set_weights.remote(ray_tpu.put(policy.get_weights()))
             self._launch_sample(worker)
             reaped += 1
-        if reaped:
-            self._replay_size = ray_tpu.get(add_fut)
+        if add_futs:
+            self._replay_size = ray_tpu.get(add_futs)[-1]
         self._timesteps_total += sampled
 
         # 2) learner: consume prefetched replay samples, refill pipeline

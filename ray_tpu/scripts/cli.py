@@ -149,6 +149,59 @@ def cmd_job_stop(args):
     print(f"stopped {args.job_id}")
 
 
+def _serve_connect(args):
+    import ray_tpu
+    ray_tpu.init(address=args.address, ignore_reinit_error=True)
+
+
+def cmd_serve_run(args):
+    """`ray-tpu serve run module:app` (reference: serve/scripts.py run)."""
+    _serve_connect(args)
+    from ray_tpu.serve.schema import ServeApplicationSchema, build_app
+    from ray_tpu.serve.api import run as serve_run
+    sys.path.insert(0, os.getcwd())
+    schema = ServeApplicationSchema(
+        name=args.name, import_path=args.import_path,
+        route_prefix=args.route_prefix)
+    app = build_app(schema)
+    serve_run(app, name=args.name, route_prefix=args.route_prefix,
+              http_port=args.port)
+    print(f"app {args.name!r} deployed from {args.import_path} "
+          f"on port {args.port}")
+    if args.blocking:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_serve_deploy(args):
+    _serve_connect(args)
+    import yaml
+    from ray_tpu.serve.schema import deploy_config
+    sys.path.insert(0, os.getcwd())
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f)
+    names = deploy_config(config)
+    print(f"deployed applications: {', '.join(names)}")
+
+
+def cmd_serve_status(args):
+    _serve_connect(args)
+    from ray_tpu import serve
+    print(json.dumps({"applications": serve.list_applications(),
+                      "deployments": serve.status()}, indent=2,
+                     default=str))
+
+
+def cmd_serve_shutdown(args):
+    _serve_connect(args)
+    from ray_tpu import serve
+    serve.shutdown()
+    print("serve shut down")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="ray-tpu",
@@ -202,6 +255,29 @@ def main(argv=None):
     sp = jsub.add_parser("list")
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_job_list)
+
+    svp = sub.add_parser("serve", help="model serving")
+    ssub = svp.add_subparsers(dest="serve_command", required=True)
+    sp = ssub.add_parser("run", help="deploy module:app and block")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--name", default="default")
+    sp.add_argument("--route-prefix", default="/")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--blocking", action="store_true", default=True)
+    sp.add_argument("--non-blocking", dest="blocking",
+                    action="store_false")
+    sp.add_argument("import_path")
+    sp.set_defaults(func=cmd_serve_run)
+    sp = ssub.add_parser("deploy", help="deploy a YAML config file")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("config_file")
+    sp.set_defaults(func=cmd_serve_deploy)
+    sp = ssub.add_parser("status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_serve_status)
+    sp = ssub.add_parser("shutdown")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_serve_shutdown)
 
     args = p.parse_args(argv)
     args.func(args)

@@ -7,14 +7,16 @@ compiles once per bucket, not per batch size.
 """
 
 from ray_tpu.serve.api import (Application, Deployment, delete,
-                               deployment, get_deployment_handle, run,
-                               shutdown, start, status)
+                               delete_application, deployment,
+                               get_deployment_handle, list_applications,
+                               run, shutdown, start, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve._private.autoscaling import AutoscalingConfig
 
 __all__ = [
     "deployment", "run", "start", "shutdown", "status", "delete",
+    "delete_application", "list_applications",
     "get_deployment_handle", "Deployment", "Application",
     "DeploymentHandle", "batch", "AutoscalingConfig",
 ]

@@ -35,13 +35,25 @@ class Application:
             if node.deployment.name in seen:
                 return
             seen[node.deployment.name] = node
-            for a in list(node.init_args) + list(
-                    node.init_kwargs.values()):
+            for a in _iter_bindable(list(node.init_args) +
+                                    list(node.init_kwargs.values())):
                 a = _unwrap(a)
                 if isinstance(a, BoundDeployment):
                     visit(a)
         visit(self.root)
         return list(seen.values())
+
+
+def _iter_bindable(values):
+    """Yield candidate bound-deployment leaves, walking one container
+    level (DAGDriver takes a {route: bound} dict)."""
+    for v in values:
+        if isinstance(v, dict):
+            yield from v.values()
+        elif isinstance(v, (list, tuple)):
+            yield from v
+        else:
+            yield v
 
 
 class BoundDeployment:
@@ -79,8 +91,13 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                user_config: Optional[Any] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               route_prefix: Optional[str] = None):
-    """@serve.deployment — mark a class/function as a deployment."""
+               route_prefix: Optional[str] = None,
+               pass_http_path: bool = False):
+    """@serve.deployment — mark a class/function as a deployment.
+
+    ``pass_http_path=True`` makes the HTTP proxy pass the request path
+    below the route prefix as a ``__serve_path__`` kwarg — the contract
+    driver deployments (drivers.DAGDriver) use to multiplex routes."""
 
     def wrap(func_or_class):
         return Deployment(
@@ -93,6 +110,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 "autoscaling_config": autoscaling_config,
                 "ray_actor_options": ray_actor_options,
                 "route_prefix": route_prefix,
+                "pass_http_path": pass_http_path,
             })
 
     return wrap if _func_or_class is None else wrap(_func_or_class)
@@ -131,39 +149,51 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
 
 
 def run(app: Union[Application, Deployment], *,
+        name: str = "default",
         route_prefix: str = "/",
         http_port: Optional[int] = _DEFAULT_HTTP_PORT,
         _blocking_timeout: float = 60.0) -> DeploymentHandle:
     """Deploy an application; returns a handle to the ingress deployment
     (reference: serve.run serve/api.py:455). ``http_port=None`` runs
-    handle-only (no HTTP ingress)."""
+    handle-only (no HTTP ingress). ``name`` scopes the app: a redeploy
+    replaces only deployments of the same app, so multiple applications
+    coexist (reference: multi-app serve.run(name=...))."""
     if isinstance(app, Deployment):
         app = app.bind()
     controller = start(http_port=http_port)
     nodes = app._collect()
     root_name = app.root.deployment.name
+
+    def _to_handle(v):
+        u = _unwrap(v)
+        if isinstance(u, BoundDeployment):
+            return DeploymentHandle(u.deployment.name, controller)
+        if isinstance(v, dict):
+            return {k: _to_handle(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(_to_handle(x) for x in v)
+        return v
+
     specs = []
     for node in nodes:
         dep = node.deployment
         # bound upstream deployments become handles at init time
-        init_args = tuple(
-            DeploymentHandle(_unwrap(a).deployment.name, controller)
-            if isinstance(_unwrap(a), BoundDeployment) else a
-            for a in node.init_args)
-        init_kwargs = {
-            k: (DeploymentHandle(_unwrap(v).deployment.name, controller)
-                if isinstance(_unwrap(v), BoundDeployment) else v)
-            for k, v in node.init_kwargs.items()}
+        init_args = tuple(_to_handle(a) for a in node.init_args)
+        init_kwargs = {k: _to_handle(v)
+                       for k, v in node.init_kwargs.items()}
         cfg = dict(dep.config)
         cfg["name"] = dep.name
+        cfg["app_name"] = name
         cfg["serialized_callable"] = cloudpickle.dumps(dep.func_or_class)
         cfg["init_args"] = init_args
         cfg["init_kwargs"] = init_kwargs
         if dep.name == root_name and not cfg.get("route_prefix"):
             cfg["route_prefix"] = route_prefix
         specs.append(cfg)
-    ray_tpu.get(controller.deploy_application.remote(specs),
-                timeout=60.0)
+    reply = ray_tpu.get(controller.deploy_application.remote(specs),
+                        timeout=60.0)
+    if isinstance(reply, dict) and reply.get("error"):
+        raise RuntimeError(reply["error"])
     _wait_healthy(controller, [s["name"] for s in specs],
                   timeout=_blocking_timeout)
     if http_port is not None:
@@ -211,6 +241,22 @@ def delete(names: Union[str, List[str]]):
         names = [names]
     ray_tpu.get(controller.delete_deployments.remote(names),
                 timeout=30.0)
+
+
+def delete_application(app_name: str):
+    """Tear down one named application (reference: serve.delete)."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(app_name),
+                timeout=60.0)
+
+
+def list_applications() -> Dict[str, List[str]]:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return ray_tpu.get(controller.list_applications.remote(),
+                           timeout=30.0)
+    except Exception:
+        return {}
 
 
 def shutdown():

@@ -62,17 +62,33 @@ class ServeController:
     # ---- API called by serve.run / handles ----
 
     def deploy_application(self, deployments: List[Dict[str, Any]]):
-        """Set target state; reconciliation happens asynchronously."""
+        """Set target state; reconciliation happens asynchronously. Only
+        deployments of the SAME app are replaced — apps coexist
+        (reference: multi-application serve)."""
+        app_name = (deployments[0].get("app_name", "default")
+                    if deployments else "default")
         with self._lock:
             new_names = {d["name"] for d in deployments}
             for d in deployments:
                 existing = self._deployments.get(d["name"])
+                if existing is not None and not \
+                        existing.config.get("_deleted") and \
+                        existing.config.get("app_name",
+                                            "default") != app_name:
+                    # a silent takeover would run app B's code under app
+                    # A's routes; deployment names are cluster-unique
+                    return {"error":
+                            f"deployment {d['name']!r} already exists in "
+                            f"app {existing.config.get('app_name')!r} — "
+                            "deployment names must be unique across apps"}
                 info = _DeploymentInfo(d)
                 if existing is not None:
                     info.replicas = existing.replicas
                     info.ready = existing.ready
                 self._deployments[d["name"]] = info
-            for stale in set(self._deployments) - new_names:
+            same_app = {n for n, i in self._deployments.items()
+                        if i.config.get("app_name", "default") == app_name}
+            for stale in same_app - new_names:
                 self._deployments[stale].target_replicas = 0
                 self._deployments[stale].config["_deleted"] = True
         self._reconcile_once()
@@ -85,6 +101,27 @@ class ServeController:
                     self._deployments[n].target_replicas = 0
                     self._deployments[n].config["_deleted"] = True
         return "ok"
+
+    def delete_application(self, app_name: str):
+        """Tear down every deployment of one app (reference:
+        serve.delete(app_name))."""
+        with self._lock:
+            for n, info in self._deployments.items():
+                if info.config.get("app_name", "default") == app_name:
+                    info.target_replicas = 0
+                    info.config["_deleted"] = True
+        self._reconcile_once()
+        return "ok"
+
+    def list_applications(self) -> Dict[str, List[str]]:
+        with self._lock:
+            out: Dict[str, List[str]] = {}
+            for n, info in self._deployments.items():
+                if info.config.get("_deleted"):
+                    continue
+                out.setdefault(
+                    info.config.get("app_name", "default"), []).append(n)
+            return out
 
     def listen_for_change(self, key: str, last_version: int):
         return self._long_poll.listen(key, last_version)
@@ -101,6 +138,7 @@ class ServeController:
                 n_live = sum(1 for h in info.replicas if h in info.ready)
                 out[name] = {
                     "name": name,
+                    "app": info.config.get("app_name", "default"),
                     "status": ("HEALTHY"
                                if n_live >= info.target_replicas
                                else "UPDATING"),
@@ -222,6 +260,8 @@ class ServeController:
                     "max_concurrent_queries":
                         info.config.get("max_concurrent_queries", 100),
                     "route_prefix": info.config.get("route_prefix"),
+                    "pass_http_path":
+                        bool(info.config.get("pass_http_path")),
                 }
         self._long_poll.notify_changed("route_table", table)
 

@@ -35,6 +35,8 @@ class HTTPProxyActor:
         self._start_server()
 
     def _on_route_update(self, table):
+        self._pass_path = {name: bool(info.get("pass_http_path"))
+                           for name, info in (table or {}).items()}
         routes = {}
         for name, info in (table or {}).items():
             prefix = info.get("route_prefix")
@@ -48,16 +50,20 @@ class HTTPProxyActor:
             self._controller.get_route_table.remote())
         self._on_route_update(table)
 
-    def _match(self, path: str) -> Optional[str]:
+    def _match(self, path: str):
+        """Longest-prefix route match → (deployment name, matched prefix)
+        or None."""
         path = path.rstrip("/") or "/"
-        best, best_len = None, -1
+        best, best_len, best_prefix = None, -1, "/"
         for prefix, name in self._routes.items():
             if (path == prefix or path.startswith(
                     prefix if prefix.endswith("/") else prefix + "/")
                     or prefix == "/"):
                 if len(prefix) > best_len:
-                    best, best_len = name, len(prefix)
-        return best
+                    best, best_len, best_prefix = name, len(prefix), prefix
+        if best is None:
+            return None
+        return best, best_prefix
 
     def _start_server(self):
         proxy = self
@@ -71,15 +77,16 @@ class HTTPProxyActor:
             def _handle(self, body: Optional[bytes]):
                 import ray_tpu
                 parsed = urlparse(self.path)
-                name = proxy._match(parsed.path)
-                if name is None:
+                matched = proxy._match(parsed.path)
+                if matched is None:
                     # maybe deployed after our last long-poll tick
                     proxy._refresh_routes()
-                    name = proxy._match(parsed.path)
-                if name is None:
+                    matched = proxy._match(parsed.path)
+                if matched is None:
                     self._respond(404, {"error":
                                         f"no route for {parsed.path}"})
                     return
+                name, route_prefix = matched
                 if body is not None and body:
                     try:
                         payload = json.loads(body)
@@ -96,9 +103,19 @@ class HTTPProxyActor:
                 attempts = 4 if self.command == "GET" else 1
                 for attempt in range(attempts):
                     try:
+                        kwargs = {}
+                        if getattr(proxy, "_pass_path", {}).get(name):
+                            # driver deployments (DAGDriver) multiplex on
+                            # the request path BELOW their route prefix
+                            sub = parsed.path
+                            if route_prefix != "/" and \
+                                    sub.startswith(route_prefix):
+                                sub = sub[len(route_prefix):] or "/"
+                            kwargs["__serve_path__"] = sub
                         ref, release = proxy._router.assign_request(
                             name, "__call__",
-                            (payload,) if payload is not None else (), {})
+                            (payload,) if payload is not None else (),
+                            kwargs)
                         try:
                             result = ray_tpu.get(ref, timeout=60.0)
                         finally:
@@ -117,19 +134,23 @@ class HTTPProxyActor:
                         fresh = proxy._match(parsed.path)
                         if fresh is None:
                             break
-                        name = fresh
+                        name, route_prefix = fresh
                     except Exception as e:
                         self._respond(500, {"error": repr(e)})
                         return
                 if attempts == 1:
-                    # resync for the NEXT request, surface a retryable
-                    # status for this one
+                    # non-idempotent request, NOT retried here and must
+                    # not be advertised retryable — the replica may have
+                    # run side effects before dying. Resync for the next
+                    # request.
                     proxy._router.force_refresh()
                     proxy._refresh_routes()
+                    self._respond(500, {"error": repr(last_err)})
+                else:
+                    # idempotent and safe to retry later (a redeploy was
+                    # likely still settling)
                     self._respond(503, {"error": repr(last_err),
                                         "retryable": True})
-                else:
-                    self._respond(500, {"error": repr(last_err)})
 
             def _respond(self, code: int, result: Any):
                 try:

@@ -134,38 +134,3 @@ class ClientActorHandle:
         return (_rehydrate_actor, (self._hex, self._class_name))
 
 
-class ClientRemoteFunction:
-    """Client counterpart of RemoteFunction: ships the pickled function
-    once (content-addressed) and submits by key."""
-
-    def __init__(self, fn, opts: Dict[str, Any]):
-        self._fn = fn
-        self._opts = dict(opts)
-
-    def options(self, **opts) -> "ClientRemoteFunction":
-        return ClientRemoteFunction(self._fn, {**self._opts, **opts})
-
-    def remote(self, *args, **kwargs):
-        return _current_client().submit_fn(
-            self._fn, args, kwargs, self._opts)
-
-    def __call__(self, *a, **k):
-        raise TypeError("Remote function cannot be called directly; "
-                        "use .remote()")
-
-
-class ClientActorClass:
-    def __init__(self, cls, opts: Dict[str, Any]):
-        self._cls = cls
-        self._opts = dict(opts)
-
-    def options(self, **opts) -> "ClientActorClass":
-        return ClientActorClass(self._cls, {**self._opts, **opts})
-
-    def remote(self, *args, **kwargs) -> ClientActorHandle:
-        return _current_client().create_actor(
-            self._cls, args, kwargs, self._opts)
-
-    def __call__(self, *a, **k):
-        raise TypeError(
-            f"Actors must be created with {self._cls.__name__}.remote()")

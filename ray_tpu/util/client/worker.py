@@ -16,9 +16,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu._private import protocol
-from ray_tpu.util.client.common import (ClientActorClass, ClientActorHandle,
-                                        ClientObjectRef,
-                                        ClientRemoteFunction)
+from ray_tpu.util.client.common import ClientActorHandle, ClientObjectRef
 
 _client: Optional["ClientWorker"] = None
 
@@ -35,7 +33,7 @@ class ClientWorker:
         self._io = protocol.EventLoopThread("ray-client")
         self._conn = self._io.run(protocol.connect(address))
         self._lock = threading.Lock()
-        self._fn_keys: Dict[int, str] = {}  # id(fn) -> server key
+        self._fn_keys: Dict[str, str] = {}  # content sha -> server key
         self.connected = True
         self.namespace = namespace
         info = self._call("client_hello", {"namespace": namespace},
@@ -107,11 +105,15 @@ class ClientWorker:
     # --------------------------------------------------------------- tasks
 
     def _export_fn(self, fn, kind: str) -> str:
-        key = self._fn_keys.get(id(fn))
+        # cache by CONTENT hash — an id(fn) key outlives the function
+        # object and a reused address would submit the wrong code
+        import hashlib
+        data = cloudpickle.dumps(fn, protocol=pickle.HIGHEST_PROTOCOL)
+        sha = hashlib.sha256(data).hexdigest()
+        key = self._fn_keys.get(sha)
         if key is None:
-            data = cloudpickle.dumps(fn, protocol=pickle.HIGHEST_PROTOCOL)
             key = self._call("client_export", {"data": data, "kind": kind})
-            self._fn_keys[id(fn)] = key
+            self._fn_keys[sha] = key
         return key
 
     def submit_fn(self, fn, args, kwargs, opts: Dict[str, Any]):

@@ -1,0 +1,19 @@
+"""Importable serve app used by the config-schema tests."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="ConfigEcho")
+class ConfigEcho:
+    def __init__(self, prefix: str = "cfg_echo"):
+        self.prefix = prefix
+
+    def __call__(self, payload=None):
+        return {self.prefix: payload}
+
+
+app = ConfigEcho.bind()
+
+
+def build_echo(prefix: str = "cfg_echo"):
+    return ConfigEcho.bind(prefix)
