@@ -6,6 +6,8 @@ blocks, static-shape batch padding, jax.device_put prefetch iterators.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data._internal.compute import (ActorPoolStrategy,
+                                            TaskPoolStrategy)
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.grouped_data import GroupedData
@@ -20,5 +22,5 @@ __all__ = [
     "BlockMetadata", "Datasource", "range", "range_tensor", "from_items",
     "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_text", "read_binary_files",
-    "read_datasource",
+    "read_datasource", "ActorPoolStrategy", "TaskPoolStrategy",
 ]

@@ -30,12 +30,17 @@ class OneToOneStage(Stage):
 
 
 class AllToAllStage(Stage):
-    """List[ref] -> List[ref] with a barrier (shuffle/sort/repartition)."""
+    """List[ref] -> List[ref] with a barrier (shuffle/sort/repartition).
+
+    ``extra`` is a mutable dict the stage fn may fill with substage
+    detail (push-shuffle task counts); it lands in the stats record."""
 
     def __init__(self, name: str,
-                 fn: Callable[[List[Any]], List[Any]]):
+                 fn: Callable[[List[Any]], List[Any]],
+                 extra: Optional[Dict[str, Any]] = None):
         self.name = name
         self.fn = fn  # (block_refs) -> block_refs
+        self.extra = extra
 
 
 def _apply_chain(fns, block):
@@ -58,18 +63,29 @@ def _get_chain_task():
 
 
 class DatasetStats:
-    """Per-stage wall time + block counts (reference: _internal/stats.py)."""
+    """Per-stage wall time + block counts + substage task breakdowns
+    (reference: _internal/stats.py DatasetStats)."""
 
     def __init__(self):
-        self.stages: List[Tuple[str, float, int]] = []
+        self.stages: List[Tuple[str, float, int,
+                                Optional[Dict[str, Any]]]] = []
 
-    def record(self, name: str, seconds: float, n_blocks: int):
-        self.stages.append((name, seconds, n_blocks))
+    def record(self, name: str, seconds: float, n_blocks: int,
+               extra: Optional[Dict[str, Any]] = None):
+        self.stages.append((name, seconds, n_blocks, extra or None))
+
+    def copy(self) -> "DatasetStats":
+        out = DatasetStats()
+        out.stages = list(self.stages)
+        return out
 
     def summary_string(self) -> str:
         lines = ["Dataset stats:"]
-        for name, secs, n in self.stages:
+        for name, secs, n, extra in self.stages:
             lines.append(f"  stage {name}: {n} blocks, {secs * 1e3:.1f}ms")
+            if extra:
+                detail = ", ".join(f"{k}={v}" for k, v in extra.items())
+                lines.append(f"    {detail}")
         return "\n".join(lines)
 
 
@@ -84,11 +100,14 @@ class ExecutionPlan:
         self.stats = stats or DatasetStats()
 
     def with_stage(self, stage: Stage) -> "ExecutionPlan":
+        # the stats history carries over COPIED: sibling datasets branched
+        # from one plan must not append into each other's stats
         if self._out_blocks is not None:
             # already executed: new plan starts from materialized blocks
-            return ExecutionPlan(self._out_blocks, [stage])
+            return ExecutionPlan(self._out_blocks, [stage],
+                                 stats=self.stats.copy())
         return ExecutionPlan(self._in_blocks, self._stages + [stage],
-                             stats=self.stats)
+                             stats=self.stats.copy())
 
     def copy_to(self, blocks: List[Any]) -> "ExecutionPlan":
         return ExecutionPlan(blocks)
@@ -117,15 +136,24 @@ class ExecutionPlan:
                     j += 1
                 fns = [s.fn for s in fused]
                 name = "+".join(s.name for s in fused)
-                task = _get_chain_task()
-                if stage.remote_opts:
-                    task = task.options(**stage.remote_opts)
-                blocks = [task.remote(fns, b) for b in blocks]
+                opts = dict(stage.remote_opts)
+                compute = opts.pop("_compute", None)
+                from ray_tpu.data._internal.compute import (
+                    ActorPoolStrategy, run_on_actor_pool)
+                if isinstance(compute, ActorPoolStrategy):
+                    blocks = run_on_actor_pool(compute, fns, blocks, opts)
+                else:
+                    task = _get_chain_task()
+                    if opts:
+                        task = task.options(**opts)
+                    blocks = [task.remote(fns, b) for b in blocks]
                 self.stats.record(name, time.time() - t0, len(blocks))
                 i = j
             else:
                 blocks = stage.fn(blocks)
-                self.stats.record(stage.name, time.time() - t0, len(blocks))
+                self.stats.record(stage.name, time.time() - t0,
+                                  len(blocks),
+                                  extra=getattr(stage, "extra", None))
                 i += 1
         # drop references to intermediates; keep outputs pinned
         self._out_blocks = blocks

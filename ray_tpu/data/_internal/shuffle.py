@@ -106,20 +106,38 @@ def _sample_keys(block: Block, key, n: int, seed):
 # ------------------------------------------------------------ drivers
 
 
+def _use_push_based(num_blocks: int) -> bool:
+    import os
+    env = os.environ.get("RTPU_PUSH_BASED_SHUFFLE")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
+    # pipelined merge only pays off past a handful of map tasks
+    return num_blocks >= 4
+
+
 def shuffle_blocks(block_refs: List[Any], output_num_blocks: int,
-                   seed: Optional[int]) -> List[Any]:
-    import ray_tpu
+                   seed: Optional[int],
+                   stats: Optional[dict] = None) -> List[Any]:
     tasks = _get_tasks()
     n = output_num_blocks
     if not block_refs:
         return []
     split = tasks["split_random"]
+    reduce = tasks["reduce_shuffle"]
+    if _use_push_based(len(block_refs)):
+        from ray_tpu.data._internal.push_based_shuffle import push_shuffle
+        # reduce takes (seed, *parts); push hands it ONE merged part
+        return push_shuffle(
+            block_refs, n, split, reduce,
+            map_args=lambda i: (None if seed is None else seed + i,),
+            reduce_args=lambda j: (
+                None if seed is None else seed + 100003 + j,),
+            stats=stats)
     parts = []  # parts[m][j]
     for m, ref in enumerate(block_refs):
         s = None if seed is None else seed + m
         out = split.options(num_returns=n).remote(ref, n, s)
         parts.append(out if isinstance(out, list) else [out])
-    reduce = tasks["reduce_shuffle"]
     outs = []
     for j in range(n):
         s = None if seed is None else seed + 100003 + j

@@ -50,7 +50,12 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[Any], Any], *,
                     batch_size: Optional[int] = None,
-                    batch_format: str = "default", **opts) -> "Dataset":
+                    batch_format: str = "default",
+                    compute=None, **opts) -> "Dataset":
+        if compute is not None:
+            from ray_tpu.data._internal.compute import resolve_compute
+            opts["_compute"] = resolve_compute(compute)
+
         def _do(block: Block) -> Block:
             acc = BlockAccessor.for_block(block)
             n = acc.num_rows()
@@ -108,11 +113,14 @@ class Dataset:
 
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_blocks: Optional[int] = None) -> "Dataset":
+        extra: Dict[str, Any] = {}
+
         def _do(refs):
             n = num_blocks or max(len(refs), 1)
-            return _shuffle.shuffle_blocks(refs, n, seed)
+            return _shuffle.shuffle_blocks(refs, n, seed, stats=extra)
         return Dataset(self._plan.with_stage(
-            AllToAllStage("random_shuffle", _do)), self._epoch)
+            AllToAllStage("random_shuffle", _do, extra=extra)),
+            self._epoch)
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         def _do(refs):
