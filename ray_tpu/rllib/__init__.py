@@ -9,14 +9,20 @@ step.
 
 from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
                                         convert_ma_batch_to_sample_batch)
-from ray_tpu.rllib.env import (Box, CartPoleEnv, Discrete, PendulumEnv,
-                               VectorEnv, make_env)
+from ray_tpu.rllib.env import (Box, CartPoleEnv, Discrete,
+                               MultiAgentCartPole, MultiAgentEnv,
+                               PendulumEnv, VectorEnv, make_env)
+from ray_tpu.rllib.connectors import (ClipActionConnector, Connector,
+                                      ConnectorPipeline,
+                                      FlattenObsConnector,
+                                      LambdaConnector, MeanStdObsConnector)
 from ray_tpu.rllib.models import MLPNet, AtariCNN, make_model
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_advantages
 from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
                                           ReplayBuffer)
-from ray_tpu.rllib.rollout_worker import (RolloutWorker, WorkerSet,
+from ray_tpu.rllib.rollout_worker import (MultiAgentRolloutWorker,
+                                          RolloutWorker, WorkerSet,
                                           synchronous_parallel_sample)
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms import (DQN, DQNConfig, IMPALA, IMPALAConfig,
@@ -27,7 +33,11 @@ __all__ = [
     "Box", "Discrete", "CartPoleEnv", "PendulumEnv", "VectorEnv",
     "make_env", "MLPNet", "AtariCNN", "make_model", "JaxPolicy",
     "compute_advantages", "ReplayBuffer", "PrioritizedReplayBuffer",
-    "RolloutWorker", "WorkerSet", "synchronous_parallel_sample",
+    "RolloutWorker", "MultiAgentRolloutWorker", "WorkerSet",
+    "synchronous_parallel_sample",
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
     "DQNConfig", "IMPALA", "IMPALAConfig",
+    "MultiAgentEnv", "MultiAgentCartPole",
+    "Connector", "ConnectorPipeline", "FlattenObsConnector",
+    "MeanStdObsConnector", "ClipActionConnector", "LambdaConnector",
 ]

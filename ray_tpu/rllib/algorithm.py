@@ -38,6 +38,12 @@ class AlgorithmConfig:
             "explore": True,
             "model": {},
             "min_sample_timesteps_per_iteration": 0,
+            # multi-agent (reference: algorithm_config.py multi_agent())
+            "multiagent": {},
+            # evaluation workers (reference: .evaluation())
+            "evaluation_interval": None,
+            "evaluation_num_episodes": 5,
+            "evaluation_num_workers": 0,
         }
 
     # fluent sections, mirroring the reference's grouping
@@ -58,6 +64,22 @@ class AlgorithmConfig:
         return self
 
     def resources(self, **kw):
+        self._config.update(kw)
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None,
+                    policies_to_train=None, **kw):
+        ma = self._config.setdefault("multiagent", {})
+        if policies is not None:
+            ma["policies"] = policies
+        if policy_mapping_fn is not None:
+            ma["policy_mapping_fn"] = policy_mapping_fn
+        if policies_to_train is not None:
+            ma["policies_to_train"] = policies_to_train
+        ma.update(kw)
+        return self
+
+    def evaluation(self, **kw):
         self._config.update(kw)
         return self
 
@@ -108,6 +130,17 @@ class Algorithm(Trainable):
             raise ValueError("config['env'] is required")
         self.workers = WorkerSet(self.config, self._policy_cls,
                                  self.config.get("num_workers", 0))
+        # evaluation WorkerSet: greedy policies, fresh envs (reference:
+        # algorithm.py evaluation_workers + evaluation_config overrides)
+        self.evaluation_workers = None
+        if self.config.get("evaluation_interval"):
+            n_eval = self.config.get("evaluation_num_workers", 0)
+            if n_eval > 0:
+                eval_cfg = dict(self.config)
+                eval_cfg["explore"] = False
+                eval_cfg["evaluation_interval"] = None
+                self.evaluation_workers = WorkerSet(
+                    eval_cfg, self._policy_cls, n_eval)
         self._iteration = 0
         self._timesteps_total = 0
         self._episode_reward_window: list = []
@@ -131,7 +164,38 @@ class Algorithm(Trainable):
             **metrics,
             **results,
         }
+        interval = self.config.get("evaluation_interval")
+        if interval and self._iteration % interval == 0:
+            out.update(self._run_evaluation())
         return out
+
+    def _run_evaluation(self) -> Dict[str, Any]:
+        n_eps = self.config.get("evaluation_num_episodes", 5)
+        if self.evaluation_workers is None:
+            return self.evaluate(num_episodes=n_eps)
+        import ray_tpu
+        # current learner weights (and connector stats — normalization
+        # must match training) onto the greedy eval policies
+        lw = self.workers.local_worker
+        ref = ray_tpu.put(lw.get_weights())
+        eval_workers = self.evaluation_workers.remote_workers
+        ray_tpu.get([w.set_weights.remote(ref) for w in eval_workers])
+        if hasattr(lw, "get_connector_state"):
+            cs = lw.get_connector_state()
+            if any(cs.values()):
+                ray_tpu.get([w.set_connector_state.remote(cs)
+                             for w in eval_workers])
+        per = max(1, n_eps // len(eval_workers))
+        rewards: list = []
+        for rw in ray_tpu.get([w.evaluate_episodes.remote(per)
+                               for w in eval_workers]):
+            rewards.extend(rw)
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+            "episodes_this_eval": len(rewards),
+        }}
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -149,28 +213,32 @@ class Algorithm(Trainable):
             "episodes_total": len(rw),
         }
 
-    def get_policy(self):
-        return self.workers.local_worker.policy
+    def get_policy(self, policy_id: Optional[str] = None):
+        lw = self.workers.local_worker
+        if policy_id is not None:
+            return lw.policy_map[policy_id]
+        return lw.policy
 
-    def compute_single_action(self, obs, explore: bool = False):
-        actions, _ = self.get_policy().compute_actions(
-            np.asarray(obs)[None], explore=explore)
+    def compute_single_action(self, obs, explore: bool = False,
+                              policy_id: Optional[str] = None):
+        lw = self.workers.local_worker
+        obs = np.asarray(obs)[None]
+        conns = getattr(lw, "obs_connectors", None)
+        if conns is not None and conns.connectors:
+            # inference must see the same preprocessing as training
+            obs = conns.transform(obs)
+        actions, _ = self.get_policy(policy_id).compute_actions(
+            obs, explore=explore)
+        act_conns = getattr(lw, "action_connectors", None)
+        if act_conns is not None and act_conns.connectors:
+            actions = act_conns.transform(actions)
         return actions[0]
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
-        """Greedy evaluation rollouts on a fresh env."""
-        from ray_tpu.rllib.env import make_env
-        env = make_env(self.config["env"], self.config.get("env_config"))
-        rewards = []
-        for ep in range(num_episodes):
-            obs, _ = env.reset(seed=10_000 + ep)
-            total, done = 0.0, False
-            while not done:
-                a = self.compute_single_action(obs)
-                obs, r, term, trunc, _ = env.step(a)
-                total += float(r)
-                done = term or trunc
-            rewards.append(total)
+        """Greedy evaluation rollouts — delegates to the local worker's
+        evaluate_episodes so single/multi-agent and connector handling
+        live in ONE place (rollout_worker.py)."""
+        rewards = self.workers.local_worker.evaluate_episodes(num_episodes)
         return {"evaluation": {
             "episode_reward_mean": float(np.mean(rewards)),
             "episode_reward_min": float(np.min(rewards)),
@@ -179,20 +247,44 @@ class Algorithm(Trainable):
 
     # ---- checkpointing (Trainable hooks) ----
 
+    @staticmethod
+    def _pickle_safe(v):
+        """Drop callables at ANY depth (policy_mapping_fn lambdas inside
+        config['multiagent'], connector instances, env builders) so the
+        checkpoint always pickles."""
+        if callable(v):
+            return None
+        if isinstance(v, dict):
+            return {k: Algorithm._pickle_safe(x) for k, x in v.items()
+                    if not callable(x)}
+        if isinstance(v, (list, tuple)):
+            return type(v)(Algorithm._pickle_safe(x) for x in v
+                           if not callable(x))
+        return v
+
     def save_checkpoint(self) -> Dict[str, Any]:
+        lw = self.workers.local_worker
         return {
-            "policy_state": self.workers.local_worker.get_policy_state(),
+            "policy_state": lw.get_policy_state(),
+            "connector_state": (lw.get_connector_state()
+                                if hasattr(lw, "get_connector_state")
+                                else None),
             "iteration": self._iteration,
             "timesteps_total": self._timesteps_total,
-            "config": {k: v for k, v in self.config.items()
-                       if not callable(v)},
+            "config": self._pickle_safe(self.config),
         }
 
     def load_checkpoint(self, state: Dict[str, Any]):
-        self.workers.local_worker.set_policy_state(state["policy_state"])
+        lw = self.workers.local_worker
+        lw.set_policy_state(state["policy_state"])
+        if state.get("connector_state") and \
+                hasattr(lw, "set_connector_state"):
+            lw.set_connector_state(state["connector_state"])
         self._iteration = state.get("iteration", 0)
         self._timesteps_total = state.get("timesteps_total", 0)
         self.workers.sync_weights()
 
     def cleanup(self):
+        if self.evaluation_workers is not None:
+            self.evaluation_workers.stop()
         self.workers.stop()

@@ -94,24 +94,45 @@ class PPO(Algorithm):
     _policy_cls = PPOPolicy
     _default_config_cls = PPOConfig
 
+    def _sgd_epochs(self, policy, batch) -> Dict[str, float]:
+        cfg = self.config
+        rng = np.random.default_rng(cfg.get("seed", 0) + self._iteration)
+        mb = cfg["sgd_minibatch_size"]
+        if batch.count < mb:
+            # padded rows carry _valid_mask=0 and are ignored by the loss
+            batch = batch.pad_to(mb)
+        stats: Dict[str, float] = {}
+        for _ in range(cfg["num_sgd_iter"]):
+            for minibatch in batch.minibatches(mb, rng=rng):
+                stats = policy.learn_on_batch(minibatch)
+        return stats
+
     def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.sample_batch import MultiAgentBatch
         cfg = self.config
         # 1. sample (reference: ppo.py:318 synchronous_parallel_sample)
         train_batch = synchronous_parallel_sample(
             self.workers, max_env_steps=cfg["train_batch_size"])
         sampled_steps = train_batch.count
         self._timesteps_total += sampled_steps
-        # 2. minibatch SGD epochs on the local (learner) policy
-        policy = self.workers.local_worker.policy
-        rng = np.random.default_rng(cfg.get("seed", 0) + self._iteration)
-        stats: Dict[str, float] = {}
-        mb = cfg["sgd_minibatch_size"]
-        if train_batch.count < mb:
-            # padded rows carry _valid_mask=0 and are ignored by the loss
-            train_batch = train_batch.pad_to(mb)
-        for _ in range(cfg["num_sgd_iter"]):
-            for minibatch in train_batch.minibatches(mb, rng=rng):
-                stats = policy.learn_on_batch(minibatch)
+        lw = self.workers.local_worker
+        # 2. minibatch SGD epochs on the local (learner) policy/policies
+        if isinstance(train_batch, MultiAgentBatch):
+            to_train = getattr(lw, "policies_to_train", None) or \
+                list(lw.policy_map)
+            learner_info: Dict[str, Dict[str, float]] = {}
+            for pid in to_train:
+                b = train_batch.policy_batches.get(pid)
+                if b is None or b.count == 0:
+                    continue
+                learner_info[pid] = self._sgd_epochs(lw.policy_map[pid], b)
+            flat = {f"learner/{pid}/{k}": v
+                    for pid, st in learner_info.items()
+                    for k, v in st.items()}
+            self.workers.sync_weights()
+            return {"num_env_steps_sampled_this_iter": sampled_steps,
+                    "info": {"learner": learner_info}, **flat}
+        stats = self._sgd_epochs(lw.policy, train_batch)
         # 3. broadcast new weights to rollout workers (ppo.py:345)
         self.workers.sync_weights()
         return {

@@ -1,0 +1,137 @@
+"""Connector pipelines: composable obs/action transforms between env and
+policy.
+
+Reference analogue: rllib/connectors/ (agent + action connectors,
+connector_pipeline_v2.py). A pipeline of small pure transforms applied
+worker-side: agent connectors on observations BEFORE the policy forward,
+action connectors on actions AFTER it — so preprocessing lives with the
+sampling worker and is identical at train and serve time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        """Transform AND update any running state (training-time path)."""
+        raise NotImplementedError
+
+    def transform(self, data: np.ndarray) -> np.ndarray:
+        """Transform WITHOUT updating state — for terminal/bootstrap
+        observations and inference, where the data must not be counted
+        twice into running statistics."""
+        return self(data)
+
+    def state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]):
+        pass
+
+
+class LambdaConnector(Connector):
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray],
+                 name: str = "lambda"):
+        self.fn = fn
+        self.name = name
+
+    def __call__(self, data):
+        return self.fn(data)
+
+
+class FlattenObsConnector(Connector):
+    """[B, ...] -> [B, prod(...)] (reference: FlattenObservations)."""
+
+    def __call__(self, obs):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class MeanStdObsConnector(Connector):
+    """Running mean/std observation normalization (reference:
+    MeanStdFilter agent connector). State ships with checkpoints."""
+
+    def __init__(self, epsilon: float = 1e-8):
+        self.eps = epsilon
+        self._count = 0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs):
+        obs = np.asarray(obs, np.float64)
+        for row in obs:
+            self._count += 1
+            if self._mean is None:
+                self._mean = np.zeros_like(row)
+                self._m2 = np.zeros_like(row)
+            delta = row - self._mean
+            self._mean += delta / self._count
+            self._m2 += delta * (row - self._mean)
+        return self.transform(obs)
+
+    def transform(self, obs):
+        obs = np.asarray(obs, np.float64)
+        if self._mean is None:
+            return obs.astype(np.float32)
+        std = np.sqrt(self._m2 / max(1, self._count - 1)) \
+            if self._count > 1 else np.ones_like(self._mean)
+        return ((obs - self._mean) / (std + self.eps)).astype(np.float32)
+
+    def state(self):
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state):
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class ClipActionConnector(Connector):
+    """Clip continuous actions into [low, high] (reference:
+    clip_actions action connector)."""
+
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def __call__(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class ConnectorPipeline:
+    def __init__(self, connectors: Optional[List[Connector]] = None):
+        self.connectors = list(connectors or [])
+
+    def __call__(self, data):
+        for c in self.connectors:
+            data = c(data)
+        return data
+
+    def transform(self, data):
+        """State-preserving application (see Connector.transform)."""
+        for c in self.connectors:
+            data = c.transform(data)
+        return data
+
+    def append(self, connector: Connector):
+        self.connectors.append(connector)
+
+    def state(self) -> List[Dict[str, Any]]:
+        return [c.state() for c in self.connectors]
+
+    def set_state(self, states: List[Dict[str, Any]]):
+        for c, s in zip(self.connectors, states):
+            c.set_state(s)
+
+
+def build_connectors(config: Dict[str, Any]):
+    """(obs_pipeline, action_pipeline) from config["connectors"]:
+    {"obs": [Connector...], "actions": [Connector...]}."""
+    spec = config.get("connectors") or {}
+    return (ConnectorPipeline(spec.get("obs")),
+            ConnectorPipeline(spec.get("actions")))

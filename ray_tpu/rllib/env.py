@@ -138,6 +138,7 @@ _BUILTIN_ENVS = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
 }
+# MultiAgentCartPole is appended below (class defined after make_env)
 
 
 class _GymnasiumAdapter:
@@ -227,3 +228,65 @@ class VectorEnv:
             infos.append(info)
         return (np.stack(obs), np.asarray(rews, np.float32),
                 np.asarray(terms), np.asarray(truncs), infos)
+
+
+class MultiAgentEnv:
+    """Multi-agent env API (reference: rllib/env/multi_agent_env.py:22).
+
+    reset() -> ({agent_id: obs}, {agent_id: info})
+    step({agent_id: action}) -> (obs, rewards, terminateds, truncateds,
+    infos) dicts keyed by agent id; terminateds/truncateds carry the
+    special "__all__" key ending the episode for everyone.
+    """
+
+    agent_ids: List[Any] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[Any, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPole instances keyed by agent id — the standard
+    multi-agent smoke env (reference: rllib/examples/env/
+    multi_agent.py MultiAgentCartPole)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.num_agents = int(config.get("num_agents", 2))
+        self.agent_ids = [f"agent_{i}" for i in range(self.num_agents)]
+        self._envs = {aid: CartPoleEnv() for aid in self.agent_ids}
+        self._done: Dict[Any, bool] = {}
+        e = next(iter(self._envs.values()))
+        self.observation_space = e.observation_space
+        self.action_space = e.action_space
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs, infos = {}, {}
+        self._done = {aid: False for aid in self.agent_ids}
+        for i, (aid, e) in enumerate(self._envs.items()):
+            s = None if seed is None else seed + i
+            o, info = e.reset(seed=s)
+            obs[aid] = o
+            infos[aid] = info
+        return obs, infos
+
+    def step(self, action_dict: Dict[Any, Any]):
+        obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+        for aid, a in action_dict.items():
+            if self._done.get(aid):
+                continue
+            o, r, term, trunc, info = self._envs[aid].step(a)
+            obs[aid], rews[aid] = o, r
+            terms[aid], truncs[aid], infos[aid] = term, trunc, info
+            if term or trunc:
+                self._done[aid] = True
+        all_done = all(self._done.values())
+        terms["__all__"] = all_done
+        truncs["__all__"] = False
+        return obs, rews, terms, truncs, infos
+
+
+_BUILTIN_ENVS["MultiAgentCartPole"] = MultiAgentCartPole

@@ -1,0 +1,178 @@
+"""Multi-agent RLlib, evaluation workers, connectors.
+
+Reference analogues: rllib/tests/test_multi_agent_env.py,
+test_evaluation.py (eval WorkerSet), connectors tests.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.env import Box
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_multiagent_env_api():
+    from ray_tpu.rllib.env import MultiAgentCartPole
+    env = MultiAgentCartPole({"num_agents": 2})
+    obs, infos = env.reset(seed=0)
+    assert set(obs) == {"agent_0", "agent_1"}
+    obs, rews, terms, truncs, infos = env.step(
+        {"agent_0": 0, "agent_1": 1})
+    assert set(rews) == {"agent_0", "agent_1"}
+    assert "__all__" in terms
+
+
+def test_multiagent_worker_sample_batches():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig, PPOPolicy
+    from ray_tpu.rllib.rollout_worker import MultiAgentRolloutWorker
+    from ray_tpu.rllib.sample_batch import MultiAgentBatch
+
+    config = (PPOConfig().environment(
+        "MultiAgentCartPole", env_config={"num_agents": 2})
+        .rollouts(rollout_fragment_length=32)
+        .multi_agent(
+            policies={"pol_a": {}, "pol_b": {}},
+            policy_mapping_fn=lambda aid: "pol_a"
+            if aid == "agent_0" else "pol_b")
+        .debugging(seed=0)).to_dict()
+    w = MultiAgentRolloutWorker(config, PPOPolicy)
+    batch = w.sample()
+    assert isinstance(batch, MultiAgentBatch)
+    assert set(batch.policy_batches) == {"pol_a", "pol_b"}
+    assert batch.env_steps() == 32
+    # both agents act until their own episode ends (an early-terminated
+    # agent sits out until "__all__"), so agent steps land in (32, 64]
+    assert 32 < batch.agent_steps() <= 64
+    # PPO postprocessing ran per trajectory (GAE columns present)
+    for b in batch.policy_batches.values():
+        assert "advantages" in b
+
+
+def test_multiagent_ppo_two_policies_learn(cluster):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig().environment(
+        "MultiAgentCartPole", env_config={"num_agents": 2})
+        .rollouts(num_workers=0, rollout_fragment_length=64)
+        .training(train_batch_size=512, sgd_minibatch_size=128,
+                  num_sgd_iter=6, lr=4e-3)
+        .multi_agent(
+            policies={"pol_a": {}, "pol_b": {}},
+            policy_mapping_fn=lambda aid: "pol_a"
+            if aid == "agent_0" else "pol_b")
+        .debugging(seed=1).build())
+    best = 0.0
+    for _ in range(30):
+        r = algo.step()
+        assert "info" in r and "learner" in r["info"]
+        if not np.isnan(r["episode_reward_mean"]):
+            best = max(best, r["episode_reward_mean"])
+        if best > 120:  # sum over both agents; random is ~40
+            break
+    learner_info = r["info"]["learner"]
+    assert set(learner_info) <= {"pol_a", "pol_b"}
+    assert len(learner_info) == 2
+    algo.cleanup()
+    assert best > 120, f"multi-agent PPO stuck at {best}"
+
+
+def test_multiagent_checkpoint_roundtrip(cluster):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig().environment(
+        "MultiAgentCartPole", env_config={"num_agents": 2})
+        .rollouts(rollout_fragment_length=16)
+        .training(train_batch_size=32, sgd_minibatch_size=16,
+                  num_sgd_iter=1)
+        .multi_agent(policies={"pol_a": {}, "pol_b": {}},
+                     policy_mapping_fn=lambda aid: "pol_a"
+                     if aid == "agent_0" else "pol_b")
+        .debugging(seed=0).build())
+    algo.step()
+    state = algo.save_checkpoint()
+    w_before = algo.get_policy("pol_a").get_weights()
+    algo2 = (PPOConfig().environment(
+        "MultiAgentCartPole", env_config={"num_agents": 2})
+        .multi_agent(policies={"pol_a": {}, "pol_b": {}},
+                     policy_mapping_fn=lambda aid: "pol_a"
+                     if aid == "agent_0" else "pol_b")
+        .debugging(seed=99).build())
+    algo2.load_checkpoint(state)
+    w_after = algo2.get_policy("pol_a").get_weights()
+    leaves_a = [np.asarray(x) for x in
+                __import__("jax").tree_util.tree_leaves(w_before)]
+    leaves_b = [np.asarray(x) for x in
+                __import__("jax").tree_util.tree_leaves(w_after)]
+    for a, b in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(a, b)
+    algo.cleanup()
+    algo2.cleanup()
+
+
+def test_evaluation_workers(cluster):
+    from ray_tpu.rllib.algorithms.pg import PGConfig
+    algo = (PGConfig().environment("CartPole-v1")
+            .rollouts(num_envs_per_worker=2, rollout_fragment_length=32)
+            .training(train_batch_size=64)
+            .evaluation(evaluation_interval=2,
+                        evaluation_num_episodes=4,
+                        evaluation_num_workers=1)
+            .debugging(seed=0).build())
+    assert algo.evaluation_workers is not None
+    r1 = algo.step()
+    assert "evaluation" not in r1  # interval=2
+    r2 = algo.step()
+    assert "evaluation" in r2
+    ev = r2["evaluation"]
+    assert ev["episodes_this_eval"] >= 4
+    assert ev["episode_reward_mean"] > 0
+    algo.cleanup()
+
+
+def test_connectors_pipeline_unit():
+    from ray_tpu.rllib.connectors import (ClipActionConnector,
+                                          ConnectorPipeline,
+                                          FlattenObsConnector,
+                                          MeanStdObsConnector)
+    p = ConnectorPipeline([FlattenObsConnector()])
+    out = p(np.zeros((4, 2, 3)))
+    assert out.shape == (4, 6)
+    clip = ClipActionConnector(-1.0, 1.0)
+    np.testing.assert_allclose(clip(np.array([-3.0, 0.5, 9.0])),
+                               [-1.0, 0.5, 1.0])
+    ms = MeanStdObsConnector()
+    rng = np.random.default_rng(0)
+    data = rng.normal(5.0, 2.0, (100, 3))
+    for i in range(0, 100, 10):
+        out = ms(data[i:i + 10])
+    # after enough samples the running normalization centers the data
+    assert abs(out.mean()) < 0.5
+    # state round-trips
+    st = ms.state()
+    ms2 = MeanStdObsConnector()
+    ms2.set_state(st)
+    np.testing.assert_allclose(ms2(data[:10]), ms(data[:10]), atol=1e-5)
+
+
+def test_connectors_in_rollout_worker(cluster):
+    from ray_tpu.rllib.algorithms.pg import PGConfig
+    from ray_tpu.rllib.connectors import MeanStdObsConnector
+
+    algo = (PGConfig().environment("CartPole-v1")
+            .rollouts(num_envs_per_worker=2, rollout_fragment_length=32)
+            .training(train_batch_size=64)
+            .update_from_dict(
+                {"connectors": {"obs": [MeanStdObsConnector()]}})
+            .debugging(seed=0).build())
+    w = algo.workers.local_worker
+    batch = w.sample()
+    # the policy saw normalized observations
+    assert abs(float(np.mean(batch["obs"]))) < 1.0
+    assert float(np.std(batch["obs"])) < 5.0
+    algo.cleanup()
