@@ -7,6 +7,15 @@ from ray_tpu.autoscaler.autoscaler import (AutoscalerMonitor,
                                            request_resources)
 from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
                                               NodeProvider)
+from ray_tpu.autoscaler.config import (ConfigError, load_config,
+                                       make_provider, prepare_config,
+                                       validate_config)
+from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
+from ray_tpu.autoscaler.commands import (create_or_update_cluster,
+                                         teardown_cluster)
 
 __all__ = ["StandardAutoscaler", "AutoscalerMonitor", "LoadMetrics",
-           "request_resources", "NodeProvider", "FakeMultiNodeProvider"]
+           "request_resources", "NodeProvider", "FakeMultiNodeProvider",
+           "GCPTPUNodeProvider", "load_config", "prepare_config",
+           "validate_config", "make_provider", "ConfigError",
+           "create_or_update_cluster", "teardown_cluster"]

@@ -1,0 +1,194 @@
+"""`ray-tpu up` / `ray-tpu down`: cluster lifecycle from a YAML config.
+
+Reference analogue: autoscaler/_private/commands.py
+(create_or_update_cluster:186, teardown_cluster:332). The fake_multinode
+provider gives the full experience on one machine (detached head process
++ worker raylets); the gcp_tpu provider provisions queued TPU-pod
+resources (in-VM bootstrap is printed, not SSH-executed — zero-egress
+environments can't reach the VMs anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional, Union
+
+from ray_tpu.autoscaler.config import (ConfigError, load_config,
+                                       make_provider, prepare_config)
+
+STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def _state_path(cluster_name: str) -> str:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    return os.path.join(STATE_DIR, f"{cluster_name}.json")
+
+
+def _load_state(cluster_name: str) -> Optional[Dict[str, Any]]:
+    p = _state_path(cluster_name)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def _save_state(cluster_name: str, state: Dict[str, Any]):
+    with open(_state_path(cluster_name), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def _resolve(config: Union[str, Dict[str, Any]]) -> Dict[str, Any]:
+    if isinstance(config, str):
+        return load_config(config)
+    return prepare_config(config)
+
+
+def _start_detached_head(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Spawn `ray-tpu start --head --block` detached; wait for the GCS
+    address to appear in its log."""
+    import tempfile
+    log = tempfile.NamedTemporaryFile(
+        prefix="rtpu_head_", suffix=".log", delete=False)
+    head_type = config.get("head_node_type")
+    res = {}
+    if head_type:
+        res = config["available_node_types"][head_type].get(
+            "resources") or {}
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--head",
+           "--block"]
+    if res.get("CPU"):
+        cmd += ["--num-cpus", str(res["CPU"])]
+    if res.get("TPU"):
+        cmd += ["--num-tpus", str(res["TPU"])]
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    deadline = time.time() + 120
+    address = None
+    while time.time() < deadline:
+        with open(log.name) as f:
+            for line in f:
+                if line.startswith("export RTPU_ADDRESS="):
+                    address = line.strip().split("=", 1)[1]
+                    break
+        if address or proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    if address is None:
+        proc.kill()
+        raise RuntimeError(
+            f"head failed to start; log: {log.name}")
+    return {"pid": proc.pid, "gcs_address": address, "log": log.name}
+
+
+def create_or_update_cluster(
+        config: Union[str, Dict[str, Any]], *,
+        api_client=None) -> Dict[str, Any]:
+    """Bring the cluster to its configured min size. Returns the state
+    dict (also persisted for `ray-tpu down`)."""
+    cfg = _resolve(config)
+    name = cfg["cluster_name"]
+    ptype = cfg["provider"]["type"]
+    state: Dict[str, Any] = {"cluster_name": name, "provider": ptype,
+                             "nodes": {}}
+
+    if ptype == "fake_multinode":
+        head = _start_detached_head(cfg)
+        state["head"] = head
+        from ray_tpu._private import node as node_mod
+        session_dir = node_mod.new_session_dir()
+        provider = make_provider(cfg, session_dir=session_dir,
+                                 gcs_address=head["gcs_address"])
+        for tname, nt in cfg["available_node_types"].items():
+            if tname == cfg.get("head_node_type"):
+                continue
+            n = nt.get("min_workers", 0)
+            if n <= 0:
+                continue
+            ids = provider.create_node(
+                {"resources": nt.get("resources") or {"CPU": 1},
+                 **nt.get("node_config", {})}, n)
+            for nid in ids:
+                info = provider._nodes.get(nid) or {}
+                proc = info.get("proc")
+                state["nodes"][nid] = {
+                    "type": tname,
+                    "pid": proc.pid if proc is not None else None,
+                }
+        _save_state(name, state)
+        return state
+
+    if ptype == "gcp_tpu":
+        provider = make_provider(cfg, api_client=api_client)
+        for tname, nt in cfg["available_node_types"].items():
+            n = nt.get("min_workers", 0)
+            if tname == cfg.get("head_node_type"):
+                n = max(n, 1)  # the head slice always exists
+            if n <= 0:
+                continue
+            existing = [i for i, s in state["nodes"].items()
+                        if s["type"] == tname]
+            ids = provider.create_node(nt.get("node_config") or {}, n)
+            for nid in ids:
+                state["nodes"][nid] = {"type": tname}
+        state["bootstrap"] = (
+            "queued resources requested; once ACTIVE, run "
+            "`ray-tpu start --head` on the head slice and "
+            "`ray-tpu start --address <head>` on workers "
+            "(setup_commands from the config apply)")
+        _save_state(name, state)
+        return state
+
+    raise ConfigError(f"ray-tpu up does not support provider {ptype!r}")
+
+
+def teardown_cluster(config: Union[str, Dict[str, Any]], *,
+                     api_client=None) -> int:
+    """Terminate every node `up` created. Returns nodes torn down."""
+    cfg = _resolve(config)
+    name = cfg["cluster_name"]
+    state = _load_state(name)
+    if state is None:
+        return 0
+    n = 0
+    ptype = state.get("provider")
+    if ptype == "fake_multinode":
+        import signal
+        # workers: direct SIGTERM per pid (they may share the caller's
+        # process group — killpg would take the caller down too)
+        for nid, info in state.get("nodes", {}).items():
+            pid = info.get("pid")
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except Exception:
+                    pass
+                n += 1
+        head = state.get("head") or {}
+        if head.get("pid"):
+            # the head got its own session (start_new_session=True): take
+            # down its whole group (GCS/raylet/workers it spawned)
+            try:
+                os.killpg(os.getpgid(head["pid"]), signal.SIGTERM)
+            except Exception:
+                try:
+                    os.kill(head["pid"], signal.SIGKILL)
+                except Exception:
+                    pass
+            n += 1
+    elif ptype == "gcp_tpu":
+        provider = make_provider(cfg, api_client=api_client)
+        for nid in state.get("nodes", {}):
+            try:
+                provider.terminate_node(nid)
+                n += 1
+            except Exception:
+                pass
+    try:
+        os.remove(_state_path(name))
+    except OSError:
+        pass
+    return n

@@ -149,6 +149,24 @@ def cmd_job_stop(args):
     print(f"stopped {args.job_id}")
 
 
+def cmd_up(args):
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+    state = create_or_update_cluster(args.config_file)
+    print(f"cluster {state['cluster_name']!r} up "
+          f"({len(state.get('nodes', {}))} worker nodes)")
+    head = state.get("head") or {}
+    if head.get("gcs_address"):
+        print(f"export RTPU_ADDRESS={head['gcs_address']}")
+    if state.get("bootstrap"):
+        print(state["bootstrap"])
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.commands import teardown_cluster
+    n = teardown_cluster(args.config_file)
+    print(f"tore down {n} nodes")
+
+
 def _serve_connect(args):
     import ray_tpu
     ray_tpu.init(address=args.address, ignore_reinit_error=True)
@@ -255,6 +273,13 @@ def main(argv=None):
     sp = jsub.add_parser("list")
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_job_list)
+
+    sp = sub.add_parser("up", help="create/update a cluster from YAML")
+    sp.add_argument("config_file")
+    sp.set_defaults(func=cmd_up)
+    sp = sub.add_parser("down", help="tear down a cluster from YAML")
+    sp.add_argument("config_file")
+    sp.set_defaults(func=cmd_down)
 
     svp = sub.add_parser("serve", help="model serving")
     ssub = svp.add_subparsers(dest="serve_command", required=True)

@@ -1,0 +1,167 @@
+"""Cluster launcher: YAML config validation, GCP TPU provider (offline
+API client), ray-tpu up/down with the fake multinode provider.
+
+Reference analogues: tests/test_autoscaler_yaml.py,
+autoscaler/_private/gcp tests, test_cli (ray up) — scaled to one box.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.config import (ConfigError, make_provider,
+                                       prepare_config)
+
+
+# ----------------------------------------------------------- config
+
+
+def _base_cfg(**over):
+    cfg = {
+        "cluster_name": "testc",
+        "provider": {"type": "fake_multinode"},
+        "available_node_types": {
+            "head": {"resources": {"CPU": 2}},
+            "worker": {"resources": {"CPU": 1}, "min_workers": 1},
+        },
+        "head_node_type": "head",
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_config_validation_errors():
+    with pytest.raises(ConfigError, match="cluster_name"):
+        prepare_config({"provider": {"type": "fake_multinode"},
+                        "available_node_types": {"a": {}}})
+    with pytest.raises(ConfigError, match="provider.type"):
+        prepare_config(_base_cfg(provider={"type": "aws"}))
+    with pytest.raises(ConfigError, match="project_id"):
+        prepare_config(_base_cfg(provider={"type": "gcp_tpu"}))
+    with pytest.raises(ConfigError, match="min_workers"):
+        prepare_config(_base_cfg(available_node_types={
+            "head": {"min_workers": 9, "max_workers": 2}}))
+    with pytest.raises(ConfigError, match="head_node_type"):
+        prepare_config(_base_cfg(head_node_type="nope"))
+    cfg = prepare_config(_base_cfg())
+    assert cfg["available_node_types"]["worker"]["max_workers"] == 8
+
+
+# ------------------------------------------------------ gcp provider
+
+
+class FakeTPUApi:
+    """Offline stand-in for the Cloud TPU queuedResources REST API."""
+
+    def __init__(self):
+        self.qrs = {}
+        self.calls = []
+
+    def request(self, method, path, body=None):
+        self.calls.append((method, path))
+        if method == "POST":
+            name = path.split("queuedResourceId=")[1]
+            self.qrs[name] = {"name": f"projects/p/locations/z/"
+                                      f"queuedResources/{name}",
+                              "state": {"state": "WAITING_FOR_RESOURCES"},
+                              "body": body}
+            return {"name": f"operations/{name}"}
+        if method == "GET" and path == "queuedResources":
+            return {"queuedResources": list(self.qrs.values())}
+        if method == "GET":
+            name = path.split("/")[-1].split("?")[0]
+            return self.qrs.get(name, {})
+        if method == "DELETE":
+            name = path.split("/")[-1].split("?")[0]
+            self.qrs.pop(name, None)
+            return {}
+        raise AssertionError(f"unexpected {method} {path}")
+
+
+def test_gcp_tpu_provider_lifecycle():
+    from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
+    api = FakeTPUApi()
+    p = GCPTPUNodeProvider(
+        {"project_id": "proj", "availability_zone": "us-central2-b",
+         "cluster_name": "mycl"}, api_client=api)
+    ids = p.create_node({"acceleratorType": "v5litepod-8",
+                         "reserved": True}, 2)
+    assert len(ids) == 2 and all(i.startswith("mycl-") for i in ids)
+    # request body carries the slice spec
+    body = api.qrs[ids[0]]["body"]
+    node = body["tpu"]["nodeSpec"][0]["node"]
+    assert node["acceleratorType"] == "v5litepod-8"
+    assert body.get("guaranteed", {}).get("reserved") is True
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    # whole-slice resources: 8 chips over 2 hosts
+    res = p.node_resources(ids[0])
+    assert res["TPU"] == 8.0 and res["tpu_slice"] == 1.0
+    assert p.node_state(ids[0]) == "WAITING_FOR_RESOURCES"
+    p.terminate_node(ids[0])
+    assert p.non_terminated_nodes() == [ids[1]]
+    # foreign queued resources are not ours
+    api.qrs["other-abc"] = {"name": ".../other-abc",
+                            "state": {"state": "ACTIVE"}}
+    assert "other-abc" not in p.non_terminated_nodes()
+
+
+def test_gcp_up_down_via_commands(tmp_path, monkeypatch):
+    from ray_tpu.autoscaler import commands
+    monkeypatch.setattr(commands, "STATE_DIR", str(tmp_path))
+    api = FakeTPUApi()
+    cfg = _base_cfg(
+        cluster_name="gcpc",
+        provider={"type": "gcp_tpu", "project_id": "proj",
+                  "availability_zone": "us-central2-b"},
+        available_node_types={
+            "head": {"resources": {"TPU": 8},
+                     "node_config": {"acceleratorType": "v5litepod-8"}},
+            "pod": {"min_workers": 2,
+                    "node_config": {"acceleratorType": "v5litepod-16"}},
+        })
+    state = commands.create_or_update_cluster(cfg, api_client=api)
+    # head slice + 2 worker slices requested
+    assert len(state["nodes"]) == 3
+    assert len(api.qrs) == 3
+    n = commands.teardown_cluster(cfg, api_client=api)
+    assert n == 3
+    assert not api.qrs
+
+
+# ------------------------------------------------- fake multinode up
+
+
+@pytest.mark.slow
+def test_up_down_fake_multinode(tmp_path, monkeypatch):
+    from ray_tpu.autoscaler import commands
+    monkeypatch.setattr(commands, "STATE_DIR", str(tmp_path))
+    cfg = _base_cfg(cluster_name="fakeup")
+    state = commands.create_or_update_cluster(cfg)
+    try:
+        assert state["head"]["gcs_address"]
+        assert len(state["nodes"]) == 1
+        # a fresh driver can join the launched cluster and see both nodes
+        import ray_tpu
+        ray_tpu.init(address=state["head"]["gcs_address"])
+        deadline = time.time() + 60
+        alive = []
+        while time.time() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["alive"]]
+            if len(alive) >= 2:
+                break
+            time.sleep(1.0)
+        assert len(alive) >= 2, alive
+
+        @ray_tpu.remote
+        def f():
+            return 7
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 7
+        ray_tpu.shutdown()
+    finally:
+        n = commands.teardown_cluster(cfg)
+    assert n >= 2
+    # state file removed
+    assert commands._load_state("fakeup") is None
