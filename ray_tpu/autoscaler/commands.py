@@ -92,54 +92,79 @@ def create_or_update_cluster(
     cfg = _resolve(config)
     name = cfg["cluster_name"]
     ptype = cfg["provider"]["type"]
-    state: Dict[str, Any] = {"cluster_name": name, "provider": ptype,
-                             "nodes": {}}
+    # IDEMPOTENT: re-running `up` reconciles against the persisted state
+    # instead of provisioning a second (leaked, billable) cluster
+    state: Dict[str, Any] = _load_state(name) or {
+        "cluster_name": name, "provider": ptype, "nodes": {}}
+
+    def _pid_alive(pid) -> bool:
+        if not pid:
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
 
     if ptype == "fake_multinode":
-        head = _start_detached_head(cfg)
-        state["head"] = head
-        from ray_tpu._private import node as node_mod
-        session_dir = node_mod.new_session_dir()
-        provider = make_provider(cfg, session_dir=session_dir,
-                                 gcs_address=head["gcs_address"])
-        for tname, nt in cfg["available_node_types"].items():
-            if tname == cfg.get("head_node_type"):
-                continue
-            n = nt.get("min_workers", 0)
-            if n <= 0:
-                continue
-            ids = provider.create_node(
-                {"resources": nt.get("resources") or {"CPU": 1},
-                 **nt.get("node_config", {})}, n)
-            for nid in ids:
-                info = provider._nodes.get(nid) or {}
-                proc = info.get("proc")
-                state["nodes"][nid] = {
-                    "type": tname,
-                    "pid": proc.pid if proc is not None else None,
-                }
-        _save_state(name, state)
+        try:
+            head = state.get("head")
+            if not head or not _pid_alive(head.get("pid")):
+                head = _start_detached_head(cfg)
+                state["head"] = head
+                # persist the head IMMEDIATELY: a later failure must not
+                # orphan the process with no record for `down`
+                _save_state(name, state)
+            from ray_tpu._private import node as node_mod
+            session_dir = node_mod.new_session_dir()
+            provider = make_provider(cfg, session_dir=session_dir,
+                                     gcs_address=head["gcs_address"])
+            # drop dead workers from the record before computing deltas
+            state["nodes"] = {nid: info for nid, info
+                              in state["nodes"].items()
+                              if _pid_alive(info.get("pid"))}
+            for tname, nt in cfg["available_node_types"].items():
+                if tname == cfg.get("head_node_type"):
+                    continue
+                have = sum(1 for s in state["nodes"].values()
+                           if s["type"] == tname)
+                for _ in range(max(0, nt.get("min_workers", 0) - have)):
+                    (nid,) = provider.create_node(
+                        {"resources": nt.get("resources") or {"CPU": 1},
+                         **nt.get("node_config", {})}, 1)
+                    state["nodes"][nid] = {
+                        "type": tname, "pid": provider.node_pid(nid)}
+                    _save_state(name, state)
+        finally:
+            _save_state(name, state)
         return state
 
     if ptype == "gcp_tpu":
         provider = make_provider(cfg, api_client=api_client)
-        for tname, nt in cfg["available_node_types"].items():
-            n = nt.get("min_workers", 0)
-            if tname == cfg.get("head_node_type"):
-                n = max(n, 1)  # the head slice always exists
-            if n <= 0:
-                continue
-            existing = [i for i, s in state["nodes"].items()
-                        if s["type"] == tname]
-            ids = provider.create_node(nt.get("node_config") or {}, n)
-            for nid in ids:
-                state["nodes"][nid] = {"type": tname}
-        state["bootstrap"] = (
-            "queued resources requested; once ACTIVE, run "
-            "`ray-tpu start --head` on the head slice and "
-            "`ray-tpu start --address <head>` on workers "
-            "(setup_commands from the config apply)")
-        _save_state(name, state)
+        try:
+            live = set(provider.non_terminated_nodes())
+            state["nodes"] = {nid: info for nid, info
+                              in state["nodes"].items() if nid in live}
+            for tname, nt in cfg["available_node_types"].items():
+                target = nt.get("min_workers", 0)
+                if tname == cfg.get("head_node_type"):
+                    target = max(target, 1)  # the head slice must exist
+                have = sum(1 for s in state["nodes"].values()
+                           if s["type"] == tname)
+                for _ in range(max(0, target - have)):
+                    (nid,) = provider.create_node(
+                        nt.get("node_config") or {}, 1)
+                    state["nodes"][nid] = {"type": tname}
+                    # every billable slice lands in the state file the
+                    # moment it is requested
+                    _save_state(name, state)
+            state["bootstrap"] = (
+                "queued resources requested; once ACTIVE, run "
+                "`ray-tpu start --head` on the head slice and "
+                "`ray-tpu start --address <head>` on workers "
+                "(setup_commands from the config apply)")
+        finally:
+            _save_state(name, state)
         return state
 
     raise ConfigError(f"ray-tpu up does not support provider {ptype!r}")

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-PROVIDER_TYPES = ("fake_multinode", "gcp_tpu", "local", "external")
+PROVIDER_TYPES = ("fake_multinode", "gcp_tpu", "external")
 
 _DEFAULTS: Dict[str, Any] = {
     "max_workers": 8,

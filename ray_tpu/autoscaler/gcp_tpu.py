@@ -20,18 +20,10 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
-# chips per host is fixed (4 for v4/v5e/v5p hosts); chips per slice come
-# from the topology string, e.g. "2x4" -> 8 chips.
+# chips per host is fixed at 4 across v4/v5e/v5p/v6e TPU-VM hosts
 ACCELERATOR_CHIPS = {
     "v4": 4, "v5litepod": 4, "v5e": 4, "v5p": 4, "v6e": 4,
 }
-
-
-def topology_chips(topology: str) -> int:
-    n = 1
-    for part in topology.lower().split("x"):
-        n *= int(part)
-    return n
 
 
 class TPUApiClient:
@@ -151,10 +143,23 @@ class GCPTPUNodeProvider(NodeProvider):
             with self._lock:
                 self._created_cfg.pop(node_id, None)
 
-    def node_resources(self, node_id: str) -> Dict[str, float]:
+    def _accelerator_type(self, node_id: str) -> str:
         with self._lock:
             cfg = self._created_cfg.get(node_id)
-        acc = (cfg or {}).get("acceleratorType", "")
+        if cfg is not None:
+            return cfg.get("acceleratorType", "")
+        # a fresh provider instance (monitor restart, `down` in a new
+        # process) recovers the slice spec from the API
+        try:
+            qr = self.api.request("GET", f"queuedResources/{node_id}")
+            node = (qr.get("tpu") or {}).get("nodeSpec", [{}])[0].get(
+                "node", {})
+            return node.get("acceleratorType", "")
+        except Exception:
+            return ""
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        acc = self._accelerator_type(node_id)
         # "v5litepod-8": suffix = chips in the slice; 4 chips per host
         if "-" in acc:
             family, n = acc.rsplit("-", 1)

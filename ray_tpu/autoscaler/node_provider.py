@@ -86,3 +86,11 @@ class FakeMultiNodeProvider(NodeProvider):
         with self._lock:
             info = self._nodes.get(node_id) or {}
         return dict(info.get("resources") or {})
+
+    def node_pid(self, node_id: str) -> Optional[int]:
+        """OS pid of the node's raylet process (launcher teardown uses
+        this; the process layout stays private to the provider)."""
+        with self._lock:
+            info = self._nodes.get(node_id) or {}
+        proc = info.get("proc")
+        return proc.pid if proc is not None else None

@@ -66,7 +66,9 @@ class FakeTPUApi:
             self.qrs[name] = {"name": f"projects/p/locations/z/"
                                       f"queuedResources/{name}",
                               "state": {"state": "WAITING_FOR_RESOURCES"},
-                              "body": body}
+                              "body": body,
+                              # the real API echoes the spec on GET
+                              "tpu": (body or {}).get("tpu", {})}
             return {"name": f"operations/{name}"}
         if method == "GET" and path == "queuedResources":
             return {"queuedResources": list(self.qrs.values())}
@@ -105,6 +107,12 @@ def test_gcp_tpu_provider_lifecycle():
     api.qrs["other-abc"] = {"name": ".../other-abc",
                             "state": {"state": "ACTIVE"}}
     assert "other-abc" not in p.non_terminated_nodes()
+    # a FRESH provider (monitor restart) recovers slice resources from
+    # the API instead of reporting a zero-capacity cluster
+    from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider as P2
+    p2 = P2({"project_id": "proj", "availability_zone": "us-central2-b",
+             "cluster_name": "mycl"}, api_client=api)
+    assert p2.node_resources(ids[1])["TPU"] == 8.0
 
 
 def test_gcp_up_down_via_commands(tmp_path, monkeypatch):
@@ -124,6 +132,10 @@ def test_gcp_up_down_via_commands(tmp_path, monkeypatch):
     state = commands.create_or_update_cluster(cfg, api_client=api)
     # head slice + 2 worker slices requested
     assert len(state["nodes"]) == 3
+    assert len(api.qrs) == 3
+    # IDEMPOTENT: a second `up` reconciles, requests nothing new
+    state2 = commands.create_or_update_cluster(cfg, api_client=api)
+    assert len(state2["nodes"]) == 3
     assert len(api.qrs) == 3
     n = commands.teardown_cluster(cfg, api_client=api)
     assert n == 3
