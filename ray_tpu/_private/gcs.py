@@ -84,6 +84,10 @@ class GcsServer:
         self.object_locations: Dict[bytes, Set[str]] = {}
         self.object_owners: Dict[bytes, str] = {}  # object hex -> worker addr
         self.subscribers: Dict[str, Set[protocol.Connection]] = {}
+        # bounded structured-event ring (reference: RAY_EVENT framework;
+        # browsable via the state API / dashboard /api/events)
+        from collections import deque
+        self.events: "deque" = deque(maxlen=1000)
         self.next_job_index = 1
         self._server = protocol.Server(self._handlers())
         self._actor_creation_waiters: Dict[str, List[asyncio.Future]] = {}
@@ -116,6 +120,8 @@ class GcsServer:
             "kill_actor": self.kill_actor,
             "wait_actor_alive": self.wait_actor_alive,
             "list_actors": self.list_actors,
+            "add_event": self.add_event,
+            "list_events": self.list_events,
             "schedule": self.schedule,
             "create_placement_group": self.create_placement_group,
             "remove_placement_group": self.remove_placement_group,
@@ -233,6 +239,25 @@ class GcsServer:
         for subs in self.subscribers.values():
             subs.discard(conn)
 
+    # ------------------------------------------------------------- events
+
+    def _event(self, severity: str, label: str, message: str, **fields):
+        from ray_tpu.util import events as ev
+        event = ev.report(severity, label, message, **fields)
+        event["source"] = "gcs"
+        self.events.append(event)
+
+    async def add_event(self, payload, conn):
+        self.events.append(payload)
+        return {}
+
+    async def list_events(self, payload, conn):
+        limit = (payload or {}).get("limit", 200)
+        sev = (payload or {}).get("severity")
+        out = [e for e in self.events
+               if sev is None or e.get("severity") == sev]
+        return out[-limit:] if limit and limit > 0 else []
+
     async def _health_loop(self):
         period = self.config.health_check_period_s
         while not self._shutdown.is_set():
@@ -249,6 +274,9 @@ class GcsServer:
             return
         node.alive = False
         logger.warning("node %s dead: %s", node_id[:8], reason)
+        self._event("ERROR", "NODE_DEAD",
+                    f"node {node_id[:8]} died: {reason}",
+                    node_id=node_id, reason=reason)
         await self._publish("node_events",
                             {"event": "dead", "node_id": node_id,
                              "reason": reason})
@@ -273,6 +301,10 @@ class GcsServer:
         # restarted GCS rebuilds its object directory
         for hex_id in payload.get("objects", ()):  # volatile directory state
             self.object_locations.setdefault(hex_id, set()).add(node_id)
+        self._event("INFO", "NODE_ADDED",
+                    f"node {node_id[:8]} registered",
+                    node_id=node_id, resources=info.total_resources,
+                    hostname=info.hostname)
         await self._publish("node_events", {"event": "alive",
                                             "node_id": node_id,
                                             "resources": info.total_resources})
@@ -527,6 +559,10 @@ class GcsServer:
         if max_restarts == -1 or info["num_restarts"] < max_restarts:
             info["num_restarts"] += 1
             info["state"] = RESTARTING
+            self._event("WARNING", "ACTOR_RESTARTING",
+                        f"actor {aid[:8]} ({info.get('class_name')}) "
+                        f"restarting: {reason}",
+                        actor_id=aid, restarts=info["num_restarts"])
             self._persist_actor(aid)
             await self._publish("actor_events",
                                 {"actor_id": aid, "state": RESTARTING})
@@ -540,6 +576,9 @@ class GcsServer:
             return
         info["state"] = DEAD
         info["death_cause"] = reason
+        self._event("ERROR", "ACTOR_DEAD",
+                    f"actor {aid[:8]} ({info.get('class_name')}) died: "
+                    f"{reason}", actor_id=aid, reason=reason)
         self._persist_actor(aid)
         await self._publish("actor_events",
                             {"actor_id": aid, "state": DEAD, "reason": reason})

@@ -16,6 +16,8 @@ async def main():
     config = SystemConfig.from_json(cfg_json) if cfg_json else SystemConfig()
     store_dir = os.environ.get("RTPU_GCS_STORE_DIR") or \
         os.path.join(session_dir, "gcs_store")
+    from ray_tpu.util import events
+    events.init_emitter("gcs", session_dir)
     gcs = GcsServer(config, store_path=store_dir)
     actual = await gcs.start("127.0.0.1", port)
     tmp = os.path.join(session_dir, ".gcs_port.tmp")

@@ -444,15 +444,35 @@ class Raylet:
                 return handle
         return None
 
+    def _event(self, severity: str, label: str, message: str, **fields):
+        """Structured event: local JSONL + best-effort ship to the GCS
+        ring (reference: RAY_EVENT)."""
+        from ray_tpu.util import events as ev
+
+        def _notify(method, payload):
+            payload["source"] = "raylet"
+            if self.gcs is not None:
+                asyncio.get_running_loop().create_task(
+                    self.gcs.notify(method, payload))
+
+        ev.report(severity, label, message, gcs_notify=_notify, **fields)
+
     async def _handle_worker_death(self, worker_id: str, reason: str):
         handle = self.workers.pop(worker_id, None)
         if handle is None:
             return
-        if worker_id in self._oom_killed_workers:
+        oom = worker_id in self._oom_killed_workers
+        if oom:
             self._oom_killed_workers.discard(worker_id)
             pct = self.config.memory_usage_threshold * 100
             reason = ("worker killed by the memory monitor: node memory "
                       f"usage exceeded {pct:.0f}% (OOM protection); {reason}")
+        if oom or handle.busy_task:
+            self._event(
+                "WARNING" if oom else "ERROR",
+                "OOM_KILL" if oom else "WORKER_DIED",
+                f"worker {worker_id[:12]} died: {reason}",
+                worker_id=worker_id, task=handle.busy_task or "")
         for lst in self.idle_workers.values():
             if handle in lst:
                 lst.remove(handle)

@@ -13,6 +13,8 @@ from ray_tpu.common.config import SystemConfig
 async def main():
     logging.basicConfig(level=os.environ.get("RTPU_LOG_LEVEL", "INFO"))
     session_dir = os.environ["RTPU_SESSION_DIR"]
+    from ray_tpu.util import events
+    events.init_emitter("raylet", session_dir)
     node_id = os.environ["RTPU_NODE_ID"]
     raylet = Raylet(
         config=SystemConfig().apply_env_overrides(),

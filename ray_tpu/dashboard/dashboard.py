@@ -83,9 +83,14 @@ class DashboardActor:
                 if path == "/metrics":
                     from ray_tpu.util import metrics
                     try:
-                        return self._text(200, metrics.prometheus_text())
+                        text = metrics.prometheus_text()
                     except Exception:
-                        return self._text(200, "")
+                        text = ""
+                    try:
+                        text += _cluster_gauges(state)
+                    except Exception:
+                        pass
+                    return self._text(200, text)
                 if path == "/api/cluster_status":
                     return self._json(200, state.summarize_cluster())
                 if path == "/api/nodes":
@@ -93,6 +98,25 @@ class DashboardActor:
                 if path == "/api/actors":
                     return self._json(200,
                                       {"actors": state.list_actors()})
+                if path == "/api/placement_groups":
+                    return self._json(
+                        200, {"placement_groups":
+                              state.list_placement_groups()})
+                if path == "/api/events":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    return self._json(200, {"events":
+                                            state.list_cluster_events(
+                        limit=int(q.get("limit", ["200"])[0]),
+                        severity=(q.get("severity") or [None])[0])})
+                if path in ("/api/logs", "/api/logs/"):
+                    return self._json(200, {"logs": state.list_logs()})
+                m = re.match(r"^/api/logs/(.+)$", path)
+                if m:
+                    try:
+                        return self._text(200, state.get_log(m.group(1)))
+                    except (ValueError, OSError) as e:
+                        return self._json(404, {"error": str(e)})
                 client = JobSubmissionClient()
                 if path in ("/api/jobs", "/api/jobs/"):
                     if method == "POST":
@@ -143,6 +167,32 @@ class DashboardActor:
 
 
 DASHBOARD_NAME = "DASHBOARD"
+
+
+def _cluster_gauges(state) -> str:
+    """Cluster-level gauges appended to /metrics (the native-metrics
+    breadth the per-process registries can't see: node counts, resource
+    totals, actor states — reference: the GCS-exported ray_* gauges)."""
+    s = state.summarize_cluster()
+    lines = []
+
+    def g(name, value, help_):
+        lines.append(f"# HELP ray_tpu_{name} {help_}")
+        lines.append(f"# TYPE ray_tpu_{name} gauge")
+        lines.append(f"ray_tpu_{name} {float(value)}")
+
+    g("cluster_nodes_alive", s["nodes_alive"], "Alive nodes")
+    g("cluster_nodes_total", s["nodes_total"], "All registered nodes")
+    g("cluster_actors_alive", s["actors_alive"], "Alive actors")
+    g("cluster_actors_total", s["actors_total"], "All actors")
+    for metric, key in (("cluster_resource_total", "cluster_resources"),
+                        ("cluster_resource_available",
+                         "available_resources")):
+        for k, v in (s.get(key) or {}).items():
+            if isinstance(v, (int, float)):
+                lines.append(
+                    f'ray_tpu_{metric}{{resource="{k}"}} {float(v)}')
+    return "\n" + "\n".join(lines) + "\n"
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:

@@ -39,6 +39,48 @@ def list_placement_groups(filters: Optional[Dict[str, Any]] = None
     return _apply_filters(_gcs_call("list_placement_groups"), filters)
 
 
+def list_cluster_events(limit: int = 200,
+                        severity: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Structured events from the GCS ring (reference: `ray list
+    cluster-events` / dashboard event browsing)."""
+    return _gcs_call("list_events", {"limit": limit,
+                                     "severity": severity})
+
+
+def list_logs() -> List[str]:
+    """Names of log files under the driver's session dir (reference:
+    `ray logs` listing via the dashboard agent)."""
+    import os
+    w = _worker_mod._global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu is not initialized")
+    d = os.path.join(w.session_dir, "logs")
+    out = []
+    for root, _dirs, files in os.walk(d):
+        rel = os.path.relpath(root, d)
+        for f in sorted(files):
+            out.append(f if rel == "." else os.path.join(rel, f))
+    return sorted(out)
+
+
+def get_log(filename: str, tail: int = 1000) -> str:
+    """Tail a session log file by its list_logs name."""
+    import os
+    w = _worker_mod._global_worker
+    if w is None or not w.connected:
+        raise RuntimeError("ray_tpu is not initialized")
+    base = os.path.realpath(os.path.join(w.session_dir, "logs"))
+    path = os.path.realpath(os.path.join(base, filename))
+    if not path.startswith(base + os.sep):
+        raise ValueError(f"log path escapes the session dir: {filename!r}")
+    from collections import deque
+    with open(path, errors="replace") as f:
+        # bounded: never load a multi-GB log whole to return its tail
+        lines = deque(f, maxlen=tail)
+    return "".join(lines)
+
+
 def summarize_cluster() -> Dict[str, Any]:
     nodes = list_nodes()
     actors = list_actors()

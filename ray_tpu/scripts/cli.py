@@ -149,6 +149,18 @@ def cmd_job_stop(args):
     print(f"stopped {args.job_id}")
 
 
+def cmd_events(args):
+    _connect(args.address)
+    from ray_tpu.experimental.state import api as state
+    for e in state.list_cluster_events(limit=args.limit,
+                                       severity=args.severity):
+        ts = time.strftime("%H:%M:%S",
+                           time.localtime(e.get("timestamp", 0)))
+        print(f"[{ts}] {e.get('severity', ''):7} "
+              f"{e.get('source', ''):7} {e.get('label', '')}: "
+              f"{e.get('message', '')}")
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.commands import create_or_update_cluster
     state = create_or_update_cluster(args.config_file)
@@ -273,6 +285,12 @@ def main(argv=None):
     sp = jsub.add_parser("list")
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_job_list)
+
+    sp = sub.add_parser("events", help="structured cluster events")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--severity", default=None)
+    sp.set_defaults(func=cmd_events)
 
     sp = sub.add_parser("up", help="create/update a cluster from YAML")
     sp.add_argument("config_file")

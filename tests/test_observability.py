@@ -1,0 +1,105 @@
+"""Structured events, log browsing, dashboard endpoints, cluster gauges.
+
+Reference analogues: event framework tests, dashboard modules tests
+(`ray list cluster-events`, `ray logs`).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.state import api as state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_node_added_event(cluster):
+    events = state.list_cluster_events()
+    labels = [e.get("label") for e in events]
+    assert "NODE_ADDED" in labels
+    ev = next(e for e in events if e.get("label") == "NODE_ADDED")
+    assert ev["severity"] == "INFO"
+    assert ev["source"] == "gcs"
+    assert ev["fields"]["resources"].get("CPU") == 4
+
+
+def test_worker_death_event(cluster):
+    import os
+    import signal
+
+    @ray_tpu.remote
+    def suicide():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(suicide.options(max_retries=0).remote(), timeout=60)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        labels = [e.get("label") for e in state.list_cluster_events()]
+        if "WORKER_DIED" in labels:
+            break
+        time.sleep(0.5)
+    assert "WORKER_DIED" in labels
+    # severity filter works
+    errors = state.list_cluster_events(severity="ERROR")
+    assert all(e["severity"] == "ERROR" for e in errors)
+    assert any(e["label"] == "WORKER_DIED" for e in errors)
+
+
+def test_actor_dead_event(cluster):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote(), timeout=30)
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    found = False
+    while time.time() < deadline and not found:
+        found = any(e.get("label") == "ACTOR_DEAD"
+                    for e in state.list_cluster_events())
+        time.sleep(0.5)
+    assert found
+
+
+def test_list_and_get_logs(cluster):
+    logs = state.list_logs()
+    assert any(name.startswith("gcs") for name in logs)
+    assert any("events" in name for name in logs)
+    gcs_log = next(n for n in logs if n.startswith("gcs"))
+    content = state.get_log(gcs_log)
+    assert "GCS listening" in content
+    with pytest.raises(ValueError, match="escapes"):
+        state.get_log("../../etc/passwd")
+
+
+def test_dashboard_events_logs_metrics(cluster):
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    port = start_dashboard(port=18265)
+
+    def get(path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30).read()
+
+    events = json.loads(get("/api/events"))["events"]
+    assert any(e["label"] == "NODE_ADDED" for e in events)
+    logs = json.loads(get("/api/logs"))["logs"]
+    assert logs
+    text = get(f"/api/logs/{logs[0]}").decode()
+    assert isinstance(text, str)
+    pgs = json.loads(get("/api/placement_groups"))
+    assert "placement_groups" in pgs
+    metrics = get("/metrics").decode()
+    assert "ray_tpu_cluster_nodes_alive 1.0" in metrics
+    assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4.0' in metrics
