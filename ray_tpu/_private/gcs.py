@@ -88,6 +88,11 @@ class GcsServer:
         # browsable via the state API / dashboard /api/events)
         from collections import deque
         self.events: "deque" = deque(maxlen=1000)
+        # scheduler's pessimistic view of its own in-flight placements:
+        # node_id -> [(expiry, demand)] (see _utilization)
+        self._ephemeral_allocs: Dict[str, List[Tuple[float, Dict[str,
+                                                                 float]]]] = {}
+        self._spread_rr = -1
         self.next_job_index = 1
         self._server = protocol.Server(self._handlers())
         self._actor_creation_waiters: Dict[str, List[asyncio.Future]] = {}
@@ -286,9 +291,10 @@ class GcsServer:
                     ALIVE, PENDING_CREATION, RESTARTING):
                 await self._handle_actor_failure(
                     aid, f"node {node_id[:8]} died: {reason}")
-        # drop object locations
+        # drop object locations + the scheduler's in-flight accounting
         for oid, locs in list(self.object_locations.items()):
             locs.discard(node_id)
+        self._ephemeral_allocs.pop(node_id, None)
 
     # ------------------------------------------------------------------- nodes
 
@@ -317,6 +323,13 @@ class GcsServer:
         node.available_resources = payload["available"]
         node.total_resources = payload.get("total", node.total_resources)
         node.last_seen = time.monotonic()
+        # a fresh report supersedes older ephemeral allocations (the task
+        # is either reflected in it or already finished) — keeping them
+        # would double-count against the node
+        allocs = self._ephemeral_allocs.get(payload["node_id"])
+        if allocs:
+            cutoff = time.monotonic() - 0.25
+            allocs[:] = [(t, d) for t, d in allocs if t > cutoff]
         return {}
 
     async def get_nodes(self, payload, conn):
@@ -677,11 +690,15 @@ class GcsServer:
         return True
 
     def _pick_node(self, demand: Dict[str, float],
-                   sched: Dict[str, Any] | None = None) -> Optional[str]:
-        """Hybrid policy (reference: hybrid_scheduling_policy.cc): prefer the
-        preferred/local node until its utilization crosses
-        scheduler_spread_threshold, then spread to the least-utilized feasible
-        node. NodeAffinity and TPU-slice constraints are strict filters."""
+                   sched: Dict[str, Any] | None = None,
+                   deps: Optional[List[str]] = None) -> Optional[str]:
+        """Hybrid policy + locality (reference:
+        hybrid_scheduling_policy.cc, scheduling_policy.cc scorer, and
+        lease_policy.cc locality): prefer the preferred/local node until
+        its utilization crosses scheduler_spread_threshold, then score
+        the feasible nodes — dependencies already present beat lower
+        utilization, so data-heavy tasks run where their args live.
+        NodeAffinity and TPU-slice constraints are strict filters."""
         sched = sched or {}
         if sched.get("node_id"):
             node = self.nodes.get(sched["node_id"])
@@ -696,30 +713,104 @@ class GcsServer:
                       if self._feasible(n, demand, labels)]
         if not candidates:
             return None
+        util = {n.node_id: self._utilization(n) for n in candidates}
+        chosen: Optional[str] = None
         if sched.get("spread"):
-            return min(candidates, key=self._utilization).node_id
-        preferred = sched.get("preferred_node")
-        if preferred:
-            node = self.nodes.get(preferred)
-            if node is not None and node in candidates and \
-                    self._utilization(node) < self.config.scheduler_spread_threshold:
-                return preferred
-        return min(candidates, key=self._utilization).node_id
+            # utilization is report-driven (stale between polls): a burst
+            # of SPREAD tasks all see identical numbers, so break ties
+            # round-robin or they all land on one node
+            candidates.sort(key=lambda n: (util[n.node_id], n.node_id))
+            low = util[candidates[0].node_id]
+            tied = [n for n in candidates
+                    if util[n.node_id] - low < 0.05]
+            self._spread_rr += 1
+            chosen = tied[self._spread_rr % len(tied)].node_id
+        if chosen is None:
+            preferred = sched.get("preferred_node")
+            if preferred:
+                node = self.nodes.get(preferred)
+                if node is not None and node in candidates and \
+                        util[preferred] < \
+                        self.config.scheduler_spread_threshold:
+                    chosen = preferred
+        if chosen is None:
+            def score(n: NodeInfo):
+                # deps-local first — but only while the holder can take
+                # this demand under the pessimistic view (locality must
+                # not pile a burst onto a node that, once spilled-to,
+                # cannot re-spill) — then lower utilization, stable by id
+                loc = (self._locality(n.node_id, deps)
+                       if self._pessimistic_headroom(n, demand) else 0)
+                return (-loc, util[n.node_id], n.node_id)
 
-    @staticmethod
-    def _utilization(node: NodeInfo) -> float:
+            chosen = min(candidates, key=score).node_id
+        # pessimistic self-accounting: this placement occupies resources
+        # NOW even though the node's next report hasn't seen it yet
+        self._ephemeral_allocs.setdefault(chosen, []).append(
+            (time.monotonic(), dict(demand)))
+        return chosen
+
+    def _locality(self, node_id: str, deps: Optional[List[str]]) -> int:
+        """How many of the task's plasma dependencies this node already
+        holds (object-size-weighted in the reference; the directory here
+        tracks locations, not sizes — count is the proxy)."""
+        if not deps:
+            return 0
+        return sum(1 for hex_id in deps
+                   if node_id in self.object_locations.get(hex_id, ()))
+
+    _EPHEMERAL_TTL = 3.0
+
+    def _pending_for(self, node_id: str) -> Dict[str, float]:
+        """Sum of this scheduler's unexpired in-flight placements."""
+        now = time.monotonic()
+        pending: Dict[str, float] = {}
+        allocs = self._ephemeral_allocs.get(node_id)
+        if allocs:
+            allocs[:] = [(t, d) for t, d in allocs
+                         if now - t < self._EPHEMERAL_TTL]
+            for _t, demand in allocs:
+                for k, v in demand.items():
+                    pending[k] = pending.get(k, 0.0) + v
+        return pending
+
+    def _effective_avail(self, node: NodeInfo, key: str,
+                         pending: Dict[str, float]) -> float:
+        """The reported availability (lags node state) and total-minus-
+        recent-placements (this scheduler's own view) are EACH an upper
+        bound on what's free; take the min — summing them double-counts
+        any task that is both reported-running and still in the
+        ephemeral window."""
+        reported = node.available_resources.get(key, 0.0)
+        own_view = node.total_resources.get(key, 0.0) - \
+            pending.get(key, 0.0)
+        return max(0.0, min(reported, own_view))
+
+    def _pessimistic_headroom(self, node: NodeInfo,
+                              demand: Dict[str, float]) -> bool:
+        pending = self._pending_for(node.node_id)
+        return all(self._effective_avail(node, k, pending) >= v
+                   for k, v in demand.items())
+
+    def _utilization(self, node: NodeInfo) -> float:
+        # node reports are poll-driven and lag the GCS's own decisions;
+        # fold in this scheduler's recent placements (ephemeral
+        # allocations, reference: cluster_resource_manager's local view)
+        # or a burst of schedule() calls piles onto one node
+        pending = self._pending_for(node.node_id)
         worst = 0.0
         for k, total in node.total_resources.items():
             if total <= 0:
                 continue
-            avail = node.available_resources.get(k, 0.0)
+            avail = self._effective_avail(node, k, pending)
             worst = max(worst, 1.0 - avail / total)
         return worst
 
     async def schedule(self, payload, conn):
         """Spillback scheduling for tasks a raylet can't place locally."""
         node_id = self._pick_node(payload.get("demand", {}),
-                                  payload.get("scheduling"))
+                                  payload.get("scheduling"),
+                                  deps=payload.get("deps"))
         if node_id is None:
             return {"node_id": None}
         return {"node_id": node_id,

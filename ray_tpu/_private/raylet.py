@@ -598,6 +598,7 @@ class Raylet:
                            chips: Tuple[int, ...] = ()):
         # freed capacity may unblock a pending task on every release path
         self._dispatch_event.set()
+        self.report_soon()
         key = self._bundle_key(ptask.spec)
         if key is not None:
             pool = self.pg_available.get(key)
@@ -652,6 +653,10 @@ class Raylet:
             r = await self.gcs.call("schedule", {
                 "demand": ptask.demand,
                 "scheduling": ptask.spec.get("scheduling") or {},
+                # locality: the GCS prefers nodes already holding the
+                # task's plasma dependencies (reference: lease_policy.cc
+                # best-node-by-dependency-bytes)
+                "deps": list(ptask.spec.get("plasma_deps") or []),
             })
         except Exception:
             return None
@@ -1319,16 +1324,38 @@ class Raylet:
             # let the death path run before re-evaluating
             await asyncio.sleep(period)
 
+    async def _send_report(self):
+        try:
+            await self.gcs.call("resource_report", {
+                "node_id": self.node_id,
+                "available": self.available,
+                "total": self.total_resources,
+            })
+        except Exception:
+            pass
+
+    def report_soon(self):
+        """Event-driven report push (debounced): resource releases reach
+        the GCS scheduler immediately instead of at the next poll tick —
+        a periodic-only view goes stale for seconds, which the cluster
+        scheduler's locality/utilization scoring inherits (reference:
+        ray_syncer's on-change broadcast vs pure polling)."""
+        if getattr(self, "_report_pending", False) or self._shutdown:
+            return
+        self._report_pending = True
+
+        async def _go():
+            await asyncio.sleep(0.05)  # debounce bursts of releases
+            self._report_pending = False
+            await self._send_report()
+        try:
+            asyncio.get_running_loop().create_task(_go())
+        except RuntimeError:
+            self._report_pending = False
+
     async def _report_loop(self):
         while not self._shutdown:
-            try:
-                await self.gcs.call("resource_report", {
-                    "node_id": self.node_id,
-                    "available": self.available,
-                    "total": self.total_resources,
-                })
-            except Exception:
-                pass
+            await self._send_report()
             await asyncio.sleep(self.config.health_check_period_s)
 
     def shutdown(self):

@@ -172,6 +172,14 @@ class ReferenceCounter:
             "owned": False, "lineage": None, "in_plasma": False,
         })
 
+    def mark_in_plasma(self, oid: ObjectID):
+        """Flag an existing entry as plasma-backed (no-op if the ref was
+        already freed)."""
+        with self.lock:
+            e = self.table.get(oid)
+            if e is not None:
+                e["in_plasma"] = True
+
     def add_owned(self, oid: ObjectID, in_plasma: bool = False,
                   lineage=None):
         with self.lock:
@@ -998,6 +1006,11 @@ class Worker:
                 ser = serialization.serialize(ind)
                 if not self.plasma.contains(oid):
                     self.memory_store.put(oid, ser.to_bytes())
+                # the owner's table must know this ref is plasma-backed —
+                # downstream tasks list it in plasma_deps (prefetch +
+                # locality-aware scheduling) even when the primary copy
+                # is on another node
+                self.reference_counter.mark_in_plasma(oid)
         if state is not None:
             if payload.get("app_error") and state.retries_left != 0 and \
                     state.spec.get("retry_exceptions"):

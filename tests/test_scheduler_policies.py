@@ -1,0 +1,117 @@
+"""Scheduler policies on a multi-node cluster: locality-aware spillback,
+node affinity strict/soft, spread.
+
+Reference analogues: test_scheduling.py locality tests (lease_policy),
+test_actor_distribution (affinity).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture(scope="module")
+def two_worker_cluster():
+    from ray_tpu._private.cluster_utils import Cluster
+    # head runs the driver only (no CPUs): every task spills back through
+    # the GCS scheduler, which is the policy under test
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 0})
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, ignore_reinit_error=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if sum(1 for n in ray_tpu.nodes() if n["alive"]) >= 3:
+            break
+        time.sleep(0.5)
+    yield cluster, n1["node_id"], n2["node_id"]
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_node_affinity_strict_and_soft(two_worker_cluster):
+    _, n1, n2 = two_worker_cluster
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    for target in (n1, n2):
+        got = ray_tpu.get(where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=target)).remote(), timeout=60)
+        assert got == target
+    # soft affinity to a dead node id still schedules somewhere
+    got = ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id="0" * 32, soft=True)).remote(), timeout=60)
+    assert got in (n1, n2)
+
+
+def test_locality_aware_spillback(two_worker_cluster):
+    _, n1, n2 = two_worker_cluster
+
+    @ray_tpu.remote
+    def produce():
+        # big enough for plasma (not inline)
+        return np.ones((512, 512), np.float32)
+
+    @ray_tpu.remote
+    def consume(arr):
+        assert arr.shape == (512, 512)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # place the dependency's primary copy deterministically on n1
+    dep = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=n1)).remote()
+    ray_tpu.wait([dep], num_returns=1, timeout=60)
+    # the location registers with the GCS directory at pin time, a beat
+    # after the owner sees readiness — poll so the policy has its input
+    from ray_tpu._private import worker as wm
+    w = wm.global_worker()
+    deadline = time.time() + 30
+    node_ids: list = []
+    while time.time() < deadline:
+        locs = w.call_sync(w.gcs, "get_object_locations",
+                           {"object_id": dep.id().hex()})
+        node_ids = [loc["node_id"]
+                    for loc in (locs.get("locations") or [])]
+        if n1 in node_ids:
+            break
+        time.sleep(0.2)
+    assert n1 in node_ids, locs
+    # unpinned consumers spill through the GCS: locality must beat the
+    # (equally utilized) other node. A short gap between consumers lets
+    # the event-driven release report land — back-to-back submits can
+    # legitimately overflow to the other node while the dep holder's
+    # last placement is still in flight (pessimistic accounting).
+    hits = []
+    for _ in range(4):
+        hits.append(ray_tpu.get(consume.remote(dep), timeout=60))
+        time.sleep(0.4)
+    # dominant preference, not perfection: one consumer may overflow to
+    # the other node while the holder's last placement is still in the
+    # pessimistic window (and its fetch then makes a REAL second copy,
+    # legitimately tying locality afterwards)
+    assert hits.count(n1) >= 3, hits
+
+
+def test_spread_distributes(two_worker_cluster):
+    _, n1, n2 = two_worker_cluster
+
+    @ray_tpu.remote
+    def where():
+        import time as _t
+        _t.sleep(0.3)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [where.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(4)]
+    got = set(ray_tpu.get(refs, timeout=60))
+    assert got == {n1, n2}
