@@ -248,6 +248,72 @@ class ClientServer:
                         "class_name": handle._class_name}
             return await _run(_do)
 
+        # ---- raw (msgpack-native) surface for non-Python clients ----
+        # Values ride as protocol-native msgpack structures, no pickling;
+        # tasks are invoked by cross_language registry name (reference:
+        # the Java/C++ workers' named-function invocation).
+
+        async def client_put_raw(payload, conn):
+            t = table(conn)
+
+            def _do():
+                return t.track_ref(ray_tpu.put(payload["value"]))
+            return await _run(_do)
+
+        async def client_get_raw(payload, conn):
+            t = table(conn)
+
+            def _do():
+                out = []
+                for h in payload["ids"]:
+                    try:
+                        value = ray_tpu.get(t.resolve_ref(h),
+                                            timeout=payload.get("timeout"))
+                        out.append({"value": value, "error": None})
+                    except BaseException as e:
+                        out.append({"value": None,
+                                    "error": f"{type(e).__name__}: {e}"})
+                return out
+            return await _run(_do)
+
+        async def client_call_named(payload, conn):
+            t = table(conn)
+
+            def _do():
+                from ray_tpu.util import cross_language
+                fn = cross_language.get_function(payload["name"])
+                opts = payload.get("opts") or {}
+                rf = ray_tpu.remote(fn) if not opts else \
+                    ray_tpu.remote(**opts)(fn)
+                args = payload.get("args") or []
+                refs = rf.remote(*args)
+                if not isinstance(refs, list):
+                    refs = [refs]
+                return [t.track_ref(r) for r in refs]
+            return await _run(_do)
+
+        async def client_list_named(payload, conn):
+            from ray_tpu.util import cross_language
+            return cross_language.list_functions()
+
+        async def client_kv(payload, conn):
+            def _do():
+                from ray_tpu._private import worker as wm
+                w = wm.global_worker()
+                op = payload["op"]
+                if op == "put":
+                    w.call_sync(w.gcs, "kv_put",
+                                {"key": payload["key"],
+                                 "value": payload["value"],
+                                 "overwrite": True})
+                    return True
+                if op == "get":
+                    r = w.call_sync(w.gcs, "kv_get",
+                                    {"key": payload["key"]})
+                    return r.get("value")
+                raise ValueError(f"bad kv op {op!r}")
+            return await _run(_do)
+
         async def client_cluster_info(payload, conn):
             def _do():
                 kind = payload["kind"]
@@ -278,6 +344,11 @@ class ClientServer:
             "client_actor_call": client_actor_call,
             "client_actor_kill": client_actor_kill,
             "client_get_actor": client_get_actor,
+            "client_put_raw": client_put_raw,
+            "client_get_raw": client_get_raw,
+            "client_call_named": client_call_named,
+            "client_list_named": client_list_named,
+            "client_kv": client_kv,
             "client_cluster_info": client_cluster_info,
             "_on_disconnect": _on_disconnect,
         }

@@ -1,0 +1,406 @@
+// ray_tpu C++ client API.
+//
+// Reference analogue: cpp/src/ray/api.cc — a non-Python driver for the
+// cluster. This client speaks the framed-msgpack control protocol
+// (ray_tpu/_private/protocol.py: [uint32 len][msgpack [type, seq,
+// method, payload]]) against the ray:// client server
+// (ray_tpu/util/client/server.py), using the raw (pickle-free) surface:
+// values are native msgpack, tasks are invoked by cross_language
+// registry name. Single-threaded synchronous calls; no external
+// dependencies (the msgpack subset codec is below).
+//
+// Usage:
+//   ray::Client c("127.0.0.1", 10001);
+//   auto ref = c.CallNamed("math.add", {ray::Value::Int(1),
+//                                       ray::Value::Int(41)});
+//   int64_t v = c.Get(ref).AsInt();             // 42
+//   auto oref = c.Put(ray::Value::Str("hello"));
+//   c.KvPut("key", "val");  c.KvGet("key");
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray {
+
+// ------------------------------------------------------------------ Value
+// A dynamic msgpack value (nil/bool/int/float/str/bin/array/map).
+
+struct Value {
+  enum class Kind { Nil, Bool, Int, Float, Str, Bin, Array, Map };
+  Kind kind = Kind::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // Str and Bin payloads
+  std::vector<Value> arr;
+  std::vector<std::pair<Value, Value>> map;
+
+  static Value Nil() { return Value{}; }
+  static Value Boolean(bool v) { Value x; x.kind = Kind::Bool; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.kind = Kind::Int; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.kind = Kind::Float; x.f = v; return x; }
+  static Value Str(std::string v) { Value x; x.kind = Kind::Str; x.s = std::move(v); return x; }
+  static Value Bin(std::string v) { Value x; x.kind = Kind::Bin; x.s = std::move(v); return x; }
+  static Value Array(std::vector<Value> v) { Value x; x.kind = Kind::Array; x.arr = std::move(v); return x; }
+  static Value MapV(std::vector<std::pair<Value, Value>> v) { Value x; x.kind = Kind::Map; x.map = std::move(v); return x; }
+
+  bool IsNil() const { return kind == Kind::Nil; }
+  int64_t AsInt() const {
+    if (kind == Kind::Int) return i;
+    if (kind == Kind::Float) return static_cast<int64_t>(f);
+    throw std::runtime_error("Value is not an int");
+  }
+  double AsFloat() const {
+    if (kind == Kind::Float) return f;
+    if (kind == Kind::Int) return static_cast<double>(i);
+    throw std::runtime_error("Value is not a float");
+  }
+  const std::string& AsStr() const {
+    if (kind != Kind::Str && kind != Kind::Bin)
+      throw std::runtime_error("Value is not a string");
+    return s;
+  }
+  const Value* MapGet(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first.kind == Kind::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+// ---------------------------------------------------------------- msgpack
+
+namespace mp {
+
+inline void PutByte(std::string& out, uint8_t b) { out.push_back(static_cast<char>(b)); }
+// value-based big-endian writes: independent of host byte order
+inline void PutBE16(std::string& out, uint16_t x) {
+  PutByte(out, static_cast<uint8_t>(x >> 8));
+  PutByte(out, static_cast<uint8_t>(x));
+}
+inline void PutBE32(std::string& out, uint32_t x) {
+  for (int k = 24; k >= 0; k -= 8) PutByte(out, static_cast<uint8_t>(x >> k));
+}
+inline void PutBE64(std::string& out, uint64_t x) {
+  for (int k = 56; k >= 0; k -= 8) PutByte(out, static_cast<uint8_t>(x >> k));
+}
+inline void PutLen(std::string& out, size_t n, uint8_t t8, uint8_t t16,
+                   uint8_t t32) {
+  if (n < 256 && t8 != 0) { PutByte(out, t8); PutByte(out, static_cast<uint8_t>(n)); }
+  else if (n < 65536) { PutByte(out, t16); PutBE16(out, static_cast<uint16_t>(n)); }
+  else { PutByte(out, t32); PutBE32(out, static_cast<uint32_t>(n)); }
+}
+
+inline void Encode(const Value& v, std::string& out) {
+  switch (v.kind) {
+    case Value::Kind::Nil: PutByte(out, 0xc0); break;
+    case Value::Kind::Bool: PutByte(out, v.b ? 0xc3 : 0xc2); break;
+    case Value::Kind::Int: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) { PutByte(out, static_cast<uint8_t>(x)); }
+      else if (x < 0 && x >= -32) { PutByte(out, static_cast<uint8_t>(0xe0 | (x + 32))); }
+      else { PutByte(out, 0xd3); PutBE64(out, static_cast<uint64_t>(x)); }
+      break;
+    }
+    case Value::Kind::Float: {
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      PutByte(out, 0xcb);
+      PutBE64(out, bits);
+      break;
+    }
+    case Value::Kind::Str: {
+      size_t n = v.s.size();
+      if (n < 32) PutByte(out, static_cast<uint8_t>(0xa0 | n));
+      else PutLen(out, n, 0xd9, 0xda, 0xdb);
+      out += v.s;
+      break;
+    }
+    case Value::Kind::Bin: {
+      PutLen(out, v.s.size(), 0xc4, 0xc5, 0xc6);
+      out += v.s;
+      break;
+    }
+    case Value::Kind::Array: {
+      size_t n = v.arr.size();
+      if (n < 16) PutByte(out, static_cast<uint8_t>(0x90 | n));
+      else PutLen(out, n, 0, 0xdc, 0xdd);
+      for (const auto& e : v.arr) Encode(e, out);
+      break;
+    }
+    case Value::Kind::Map: {
+      size_t n = v.map.size();
+      if (n < 16) PutByte(out, static_cast<uint8_t>(0x80 | n));
+      else PutLen(out, n, 0, 0xde, 0xdf);
+      for (const auto& kv : v.map) { Encode(kv.first, out); Encode(kv.second, out); }
+      break;
+    }
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint8_t Byte() {
+    if (p >= end) throw std::runtime_error("msgpack: truncated");
+    return *p++;
+  }
+  void Bytes(void* dst, size_t n) {
+    if (p + n > end) throw std::runtime_error("msgpack: truncated");
+    std::memcpy(dst, p, n);
+    p += n;
+  }
+  uint64_t BE(size_t n) {
+    uint64_t x = 0;
+    for (size_t k = 0; k < n; ++k) x = (x << 8) | Byte();
+    return x;
+  }
+  std::string Raw(size_t n) {
+    if (p + n > end) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+inline Value Decode(Reader& r) {
+  uint8_t t = r.Byte();
+  if (t < 0x80) return Value::Int(t);
+  if (t >= 0xe0) return Value::Int(static_cast<int8_t>(t));
+  if ((t & 0xf0) == 0x90 || t == 0xdc || t == 0xdd) {
+    size_t n = (t == 0xdc) ? r.BE(2) : (t == 0xdd) ? r.BE(4) : (t & 0x0f);
+    std::vector<Value> a;
+    a.reserve(n);
+    for (size_t k = 0; k < n; ++k) a.push_back(Decode(r));
+    return Value::Array(std::move(a));
+  }
+  if ((t & 0xf0) == 0x80 || t == 0xde || t == 0xdf) {
+    size_t n = (t == 0xde) ? r.BE(2) : (t == 0xdf) ? r.BE(4) : (t & 0x0f);
+    std::vector<std::pair<Value, Value>> m;
+    m.reserve(n);
+    for (size_t k = 0; k < n; ++k) {
+      Value key = Decode(r);
+      Value val = Decode(r);
+      m.emplace_back(std::move(key), std::move(val));
+    }
+    return Value::MapV(std::move(m));
+  }
+  if ((t & 0xe0) == 0xa0) return Value::Str(r.Raw(t & 0x1f));
+  switch (t) {
+    case 0xc0: return Value::Nil();
+    case 0xc2: return Value::Boolean(false);
+    case 0xc3: return Value::Boolean(true);
+    case 0xc4: return Value::Bin(r.Raw(r.BE(1)));
+    case 0xc5: return Value::Bin(r.Raw(r.BE(2)));
+    case 0xc6: return Value::Bin(r.Raw(r.BE(4)));
+    case 0xca: { uint32_t x = static_cast<uint32_t>(r.BE(4)); float f;
+                 std::memcpy(&f, &x, 4); return Value::Float(f); }
+    case 0xcb: { uint64_t x = r.BE(8); double d; std::memcpy(&d, &x, 8);
+                 return Value::Float(d); }
+    case 0xcc: return Value::Int(static_cast<int64_t>(r.BE(1)));
+    case 0xcd: return Value::Int(static_cast<int64_t>(r.BE(2)));
+    case 0xce: return Value::Int(static_cast<int64_t>(r.BE(4)));
+    case 0xcf: return Value::Int(static_cast<int64_t>(r.BE(8)));
+    case 0xd0: return Value::Int(static_cast<int8_t>(r.BE(1)));
+    case 0xd1: return Value::Int(static_cast<int16_t>(r.BE(2)));
+    case 0xd2: return Value::Int(static_cast<int32_t>(r.BE(4)));
+    case 0xd3: return Value::Int(static_cast<int64_t>(r.BE(8)));
+    case 0xd9: return Value::Str(r.Raw(r.BE(1)));
+    case 0xda: return Value::Str(r.Raw(r.BE(2)));
+    case 0xdb: return Value::Str(r.Raw(r.BE(4)));
+    default:
+      throw std::runtime_error("msgpack: unsupported type byte");
+  }
+}
+
+}  // namespace mp
+
+// ---------------------------------------------------------------- Client
+
+class ObjectRef {
+ public:
+  explicit ObjectRef(std::string hex = "") : hex_(std::move(hex)) {}
+  const std::string& Hex() const { return hex_; }
+
+ private:
+  std::string hex_;
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect() failed");
+    Value hello = Call("client_hello",
+                       {{Value::Str("namespace"), Value::Str("")}});
+    (void)hello;
+  }
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  ObjectRef Put(const Value& v) {
+    Value r = Call("client_put_raw", {{Value::Str("value"), v}});
+    return ObjectRef(r.AsStr());
+  }
+
+  Value Get(const ObjectRef& ref, double timeout_s = 60.0) {
+    Value r = Call("client_get_raw",
+                   {{Value::Str("ids"),
+                     Value::Array({Value::Str(ref.Hex())})},
+                    {Value::Str("timeout"), Value::Float(timeout_s)}});
+    const Value& item = r.arr.at(0);
+    const Value* err = item.MapGet("error");
+    if (err != nullptr && !err->IsNil())
+      throw std::runtime_error("remote error: " + err->AsStr());
+    const Value* val = item.MapGet("value");
+    return val == nullptr ? Value::Nil() : *val;
+  }
+
+  // Invoke a Python function registered via
+  // ray_tpu.util.cross_language.register_function(name, fn). The server
+  // replies with the list of return refs; single-return calls get one.
+  std::vector<ObjectRef> CallNamedMulti(const std::string& name,
+                                        std::vector<Value> args) {
+    Value r = Call("client_call_named",
+                   {{Value::Str("name"), Value::Str(name)},
+                    {Value::Str("args"), Value::Array(std::move(args))}});
+    std::vector<ObjectRef> out;
+    for (const auto& h : r.arr) out.emplace_back(h.AsStr());
+    return out;
+  }
+
+  ObjectRef CallNamed(const std::string& name, std::vector<Value> args) {
+    auto refs = CallNamedMulti(name, std::move(args));
+    if (refs.empty()) throw std::runtime_error("no return ref");
+    return refs.front();
+  }
+
+  // Drop the server-side pin for a ref this client no longer needs
+  // (fire-and-forget; the table otherwise holds it until disconnect).
+  void Release(const ObjectRef& ref) {
+    Notify("client_release",
+           {{Value::Str("ids"),
+             Value::Array({Value::Str(ref.Hex())})}});
+  }
+
+  std::vector<std::string> ListNamed() {
+    Value r = Call("client_list_named",
+                   std::vector<std::pair<Value, Value>>{});
+    std::vector<std::string> out;
+    for (const auto& v : r.arr) out.push_back(v.AsStr());
+    return out;
+  }
+
+  void KvPut(const std::string& key, const std::string& value) {
+    Call("client_kv", {{Value::Str("op"), Value::Str("put")},
+                       {Value::Str("key"), Value::Str(key)},
+                       {Value::Str("value"), Value::Bin(value)}});
+  }
+
+  std::string KvGet(const std::string& key) {
+    Value r = Call("client_kv", {{Value::Str("op"), Value::Str("get")},
+                                 {Value::Str("key"), Value::Str(key)}});
+    return r.IsNil() ? std::string() : r.AsStr();
+  }
+
+  Value ClusterResources() {
+    return Call("client_cluster_info",
+                {{Value::Str("kind"), Value::Str("cluster_resources")}});
+  }
+
+  // One framed request/reply round-trip (msg types per protocol.py:
+  // 0=request, 1=reply, 2=error, 3=notify).
+  Value Call(const std::string& method,
+             std::vector<std::pair<Value, Value>> payload) {
+    int64_t seq = ++seq_;
+    SendFrame(Value::Array({Value::Int(0), Value::Int(seq),
+                            Value::Str(method),
+                            Value::MapV(std::move(payload))}));
+    for (;;) {
+      Value msg = ReadFrame();
+      int64_t mtype = msg.arr.at(0).AsInt();
+      int64_t mseq = msg.arr.at(1).AsInt();
+      if (mseq != seq) continue;  // single-threaded: stale replies only
+      if (mtype == 2)
+        throw std::runtime_error("rpc error: " + msg.arr.at(3).AsStr());
+      return msg.arr.at(3);
+    }
+  }
+
+  void Notify(const std::string& method,
+              std::vector<std::pair<Value, Value>> payload) {
+    SendFrame(Value::Array({Value::Int(3), Value::Nil(),
+                            Value::Str(method),
+                            Value::MapV(std::move(payload))}));
+  }
+
+ private:
+  void SendFrame(const Value& body) {
+    std::string data;
+    mp::Encode(body, data);
+    // protocol.py frames with little-endian "<I"
+    uint32_t n = static_cast<uint32_t>(data.size());
+    std::string frame;
+    for (int k = 0; k < 32; k += 8)
+      frame.push_back(static_cast<char>((n >> k) & 0xff));
+    frame += data;
+    SendAll(frame.data(), frame.size());
+  }
+
+  void SendAll(const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send() failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void RecvAll(char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::recv(fd_, p, n, 0);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+  Value ReadFrame() {
+    uint8_t hdr[4];
+    RecvAll(reinterpret_cast<char*>(hdr), 4);
+    uint32_t n = static_cast<uint32_t>(hdr[0]) |
+                 (static_cast<uint32_t>(hdr[1]) << 8) |
+                 (static_cast<uint32_t>(hdr[2]) << 16) |
+                 (static_cast<uint32_t>(hdr[3]) << 24);
+    std::string buf(n, '\0');
+    RecvAll(buf.data(), n);
+    mp::Reader r{reinterpret_cast<const uint8_t*>(buf.data()),
+                 reinterpret_cast<const uint8_t*>(buf.data()) + n};
+    return mp::Decode(r);
+  }
+
+  int fd_ = -1;
+  int64_t seq_ = 0;
+};
+
+}  // namespace ray

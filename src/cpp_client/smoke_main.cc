@@ -1,0 +1,89 @@
+// End-to-end smoke driver for the C++ client API (built and run by
+// tests/test_cpp_client.py against a live cluster + client server).
+// Exits 0 on success; prints the failing step otherwise.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "ray_tpu_client.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <port>\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray::Client c("127.0.0.1", std::atoi(argv[1]));
+
+    // put/get round-trips across types
+    auto r1 = c.Put(ray::Value::Int(12345));
+    if (c.Get(r1).AsInt() != 12345) { std::puts("FAIL int"); return 1; }
+    auto r2 = c.Put(ray::Value::Str("hello from c++"));
+    if (c.Get(r2).AsStr() != "hello from c++") {
+      std::puts("FAIL str");
+      return 1;
+    }
+    auto r3 = c.Put(ray::Value::Array(
+        {ray::Value::Int(1), ray::Value::Float(2.5),
+         ray::Value::Str("x")}));
+    auto v3 = c.Get(r3);
+    if (v3.arr.size() != 3 || v3.arr[1].AsFloat() != 2.5) {
+      std::puts("FAIL array");
+      return 1;
+    }
+
+    // cross-language task invocation by registered name
+    auto names = c.ListNamed();
+    bool found = false;
+    for (const auto& n : names) found = found || n == "math.add";
+    if (!found) { std::puts("FAIL list_named"); return 1; }
+    auto rr = c.CallNamed("math.add",
+                          {ray::Value::Int(1), ray::Value::Int(41)});
+    if (c.Get(rr).AsInt() != 42) { std::puts("FAIL call_named"); return 1; }
+    // chain: pass a fetched value back into another call
+    auto rs = c.CallNamed("str.concat", {ray::Value::Str("tpu-"),
+                                         ray::Value::Str("native")});
+    if (c.Get(rs).AsStr() != "tpu-native") {
+      std::puts("FAIL concat");
+      return 1;
+    }
+
+    // error propagation
+    bool threw = false;
+    try {
+      auto rb = c.CallNamed("math.boom", {});
+      c.Get(rb);
+    } catch (const std::exception& e) {
+      threw = std::strstr(e.what(), "kaboom") != nullptr;
+    }
+    if (!threw) { std::puts("FAIL error-propagation"); return 1; }
+
+    // large payloads exercise the str32 encode path (>64 KiB)
+    std::string big(100000, 'x');
+    auto rbig = c.Put(ray::Value::Str(big));
+    if (c.Get(rbig).AsStr() != big) { std::puts("FAIL big-str"); return 1; }
+    c.Release(rbig);
+    c.Release(r1);
+    // the connection must still be healthy after notifies
+    if (c.Get(r2).AsStr() != "hello from c++") {
+      std::puts("FAIL post-release");
+      return 1;
+    }
+
+    // kv + cluster info
+    c.KvPut("cpp/key", "cpp-value");
+    if (c.KvGet("cpp/key") != "cpp-value") { std::puts("FAIL kv"); return 1; }
+    auto res = c.ClusterResources();
+    const ray::Value* cpu = res.MapGet("CPU");
+    if (cpu == nullptr || cpu->AsFloat() < 1.0) {
+      std::puts("FAIL cluster_resources");
+      return 1;
+    }
+
+    std::puts("CPP_CLIENT_OK");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "FAIL exception: %s\n", e.what());
+    return 1;
+  }
+}
