@@ -78,6 +78,10 @@ class DashboardActor:
                 from ray_tpu.experimental.state import api as state
                 from ray_tpu.job_submission import JobSubmissionClient
                 path = self.path.split("?")[0]
+                if path in ("/", "/index.html"):
+                    from ray_tpu.dashboard.frontend import INDEX_HTML
+                    return self._text(200, INDEX_HTML,
+                                      ctype="text/html")
                 if path == "/healthz":
                     return self._text(200, "ok")
                 if path == "/metrics":

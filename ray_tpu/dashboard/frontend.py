@@ -1,0 +1,133 @@
+"""Single-file dashboard frontend (reference: the dashboard/client React
+app, scaled to a dependency-free page served by the same process). Polls
+the REST endpoints: cluster status, nodes, actors, jobs, events, logs.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 13px/1.45 system-ui, sans-serif; margin: 0;
+         background: Canvas; color: CanvasText; }
+  header { padding: 10px 16px; border-bottom: 1px solid color-mix(in srgb,
+           CanvasText 18%, transparent); display: flex; gap: 16px;
+           align-items: baseline; }
+  header h1 { font-size: 15px; margin: 0; }
+  header .muted { opacity: .65; }
+  main { padding: 12px 16px; display: grid; gap: 14px; }
+  section h2 { font-size: 13px; margin: 0 0 6px;
+               text-transform: uppercase; letter-spacing: .06em;
+               opacity: .75; }
+  .tiles { display: flex; gap: 10px; flex-wrap: wrap; }
+  .tile { border: 1px solid color-mix(in srgb, CanvasText 18%,
+          transparent); border-radius: 8px; padding: 8px 14px;
+          min-width: 110px; }
+  .tile b { display: block; font-size: 20px; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0; border-bottom:
+           1px solid color-mix(in srgb, CanvasText 10%, transparent);
+           font-variant-numeric: tabular-nums; white-space: nowrap; }
+  th { opacity: .7; font-weight: 600; }
+  td.msg { white-space: normal; }
+  .sev-ERROR, .sev-FATAL { color: #c62828; font-weight: 600; }
+  .sev-WARNING { color: #b26a00; font-weight: 600; }
+  pre { background: color-mix(in srgb, CanvasText 6%, transparent);
+        padding: 8px; border-radius: 6px; max-height: 320px;
+        overflow: auto; }
+  a { color: inherit; }
+  select { font: inherit; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="muted" id="updated"></span>
+</header>
+<main>
+  <section><h2>Cluster</h2><div class="tiles" id="tiles"></div></section>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Actors</h2><table id="actors"></table></section>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Events</h2><table id="events"></table></section>
+  <section>
+    <h2>Logs</h2>
+    <select id="logsel"></select>
+    <pre id="logview">(select a log)</pre>
+  </section>
+</main>
+<script>
+const get = async p => (await fetch(p)).json();
+const esc = s => String(s).replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const row = cells => "<tr>" + cells.map(c => "<td" +
+  (c && c.cls ? ` class="${c.cls}"` : "") + ">" +
+  esc(c && c.v !== undefined ? c.v : c) + "</td>").join("") + "</tr>";
+const head = cols => "<tr>" + cols.map(c => `<th>${c}</th>`).join("")
+  + "</tr>";
+
+async function refresh() {
+  try {
+    const s = await get("/api/cluster_status");
+    const res = s.cluster_resources || {};
+    document.getElementById("tiles").innerHTML = [
+      ["nodes alive", s.nodes_alive + "/" + s.nodes_total],
+      ["actors alive", s.actors_alive + "/" + s.actors_total],
+      ["CPU", res.CPU ?? 0], ["TPU", res.TPU ?? 0],
+    ].map(([k, v]) => `<div class="tile"><b>${esc(v)}</b>${esc(k)}
+      </div>`).join("");
+
+    const nodes = (await get("/api/nodes")).nodes || [];
+    document.getElementById("nodes").innerHTML =
+      head(["node", "alive", "resources", "available"]) +
+      nodes.map(n => row([n.node_id.slice(0, 12), n.alive,
+        JSON.stringify(n.resources), JSON.stringify(n.available)]))
+        .join("");
+
+    const actors = (await get("/api/actors")).actors || [];
+    document.getElementById("actors").innerHTML =
+      head(["actor", "class", "state", "restarts"]) +
+      actors.map(a => row([(a.actor_id || "").slice(0, 12),
+        a.class_name, a.state, a.num_restarts || 0])).join("");
+
+    const jobs = (await get("/api/jobs")).jobs || [];
+    document.getElementById("jobs").innerHTML =
+      head(["job", "status", "entrypoint"]) +
+      jobs.map(j => row([j.job_id, j.status,
+        (j.entrypoint || "").slice(0, 90)])).join("");
+
+    const events = (await get("/api/events?limit=50")).events || [];
+    document.getElementById("events").innerHTML =
+      head(["time", "severity", "source", "label", "message"]) +
+      events.slice().reverse().map(e => row([
+        new Date(e.timestamp * 1000).toLocaleTimeString(),
+        {v: e.severity, cls: "sev-" + e.severity}, e.source, e.label,
+        {v: e.message, cls: "msg"}])).join("");
+
+    const sel = document.getElementById("logsel");
+    if (!sel.dataset.loaded) {
+      const logs = (await get("/api/logs")).logs || [];
+      sel.innerHTML = "<option value=''>(select a log)</option>" +
+        logs.map(l => `<option>${esc(l)}</option>`).join("");
+      sel.dataset.loaded = "1";
+      sel.onchange = async () => {
+        if (!sel.value) return;
+        const r = await fetch("/api/logs/" +
+                              encodeURIComponent(sel.value));
+        document.getElementById("logview").textContent = await r.text();
+      };
+    }
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("updated").textContent = "error: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 4000);
+</script>
+</body>
+</html>
+"""

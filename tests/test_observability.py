@@ -103,3 +103,13 @@ def test_dashboard_events_logs_metrics(cluster):
     metrics = get("/metrics").decode()
     assert "ray_tpu_cluster_nodes_alive 1.0" in metrics
     assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4.0' in metrics
+
+
+def test_dashboard_frontend_page(cluster):
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    port = start_dashboard(port=18265)  # reuses the module's instance
+    html = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=30).read().decode()
+    assert "<!doctype html>" in html
+    assert "/api/cluster_status" in html
+    assert "ray_tpu" in html
