@@ -34,6 +34,7 @@ __all__ = [
 
 _init_lock = threading.Lock()
 _node_processes: Optional[_node_mod.NodeProcesses] = None
+_storage_env_set = False  # init(storage=...) set RTPU_STORAGE this run
 
 
 def _client():
@@ -59,6 +60,7 @@ def init(address: Optional[str] = None, *,
          labels: Optional[Dict[str, str]] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "",
+         storage: Optional[str] = None,
          ignore_reinit_error: bool = False,
          _system_config: Optional[Dict[str, Any]] = None,
          log_to_driver: bool = True) -> Dict[str, Any]:
@@ -66,7 +68,7 @@ def init(address: Optional[str] = None, *,
 
     Reference analogue: ray.init (python/ray/_private/worker.py:1031).
     """
-    global _node_processes
+    global _node_processes, _storage_env_set
     with _init_lock:
         if is_initialized():
             if ignore_reinit_error:
@@ -86,6 +88,11 @@ def init(address: Optional[str] = None, *,
             # (reference: ray.init("ray://...") → util/client_connect.py)
             from ray_tpu.util.client import worker as _cw
             c = _cw.connect(address[len("ray://"):], namespace=namespace)
+            if storage is not None:
+                os.environ["RTPU_STORAGE"] = storage
+                _storage_env_set = True
+                c._call("client_kv", {"op": "put", "key": "@storage/root",
+                                      "value": storage.encode()})
             return {"address": address, "namespace": namespace,
                     **{k: v for k, v in c.server_info.items()}}
         res: Dict[str, float] = dict(resources or {})
@@ -95,6 +102,13 @@ def init(address: Optional[str] = None, *,
             res["TPU"] = float(num_tpus)
         if num_gpus is not None:
             res["GPU"] = float(num_gpus)
+
+        if storage is not None:
+            # cluster-wide storage root (reference: ray.init(storage=) →
+            # _private/storage.py): workflows and any component needing
+            # durable shared storage resolve it from here
+            os.environ["RTPU_STORAGE"] = storage
+            _storage_env_set = True
 
         w = Worker()
         w.log_to_driver = log_to_driver
@@ -127,6 +141,9 @@ def init(address: Optional[str] = None, *,
                       if False else _raylet_unix_for(target, session_dir),
                       target["object_store_path"], target["node_id"],
                       session_dir, namespace=namespace)
+        if storage is not None:
+            from ray_tpu._private.storage import _publish
+            _publish(storage)
         w.config = config
         w.runtime_context = {
             "gcs_address": w.gcs and address or
@@ -151,7 +168,11 @@ def _raylet_unix_for(node_info: Dict[str, Any], session_dir: str) -> str:
 
 
 def shutdown():
-    global _node_processes
+    global _node_processes, _storage_env_set
+    if _storage_env_set:
+        # don't leak this run's storage root into the next init
+        os.environ.pop("RTPU_STORAGE", None)
+        _storage_env_set = False
     if _client() is not None:
         from ray_tpu.util.client import worker as _cw
         _cw.disconnect()

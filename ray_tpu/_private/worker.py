@@ -1227,12 +1227,36 @@ class Worker:
             self._actor_threads = ThreadPoolExecutor(
                 max_workers=max_concurrency,
                 thread_name_prefix="actor-exec")
+            # concurrency groups (reference: actor concurrency groups,
+            # core_worker/transport/concurrency_group_manager): named
+            # executors so e.g. "io" calls never starve "compute" calls
+            self._actor_group_threads = {
+                name: ThreadPoolExecutor(
+                    max_workers=int(n),
+                    thread_name_prefix=f"actor-{name}")
+                for name, n in (spec.get("concurrency_groups")
+                                or {}).items()}
             self._actor_instance = cls(*args, **kwargs)
             self.mode = MODE_WORKER
             return None
         except BaseException as e:  # noqa: BLE001
             logger.error("actor init failed: %s", traceback.format_exc())
             return f"{type(e).__name__}: {e}"
+
+    def _executor_for(self, method) -> ThreadPoolExecutor:
+        group = getattr(method, "__rtpu_method_opts__",
+                        {}).get("concurrency_group")
+        if group:
+            groups = getattr(self, "_actor_group_threads", {})
+            ex = groups.get(group)
+            if ex is None:
+                # silently landing on the default executor would recreate
+                # exactly the starvation the group was meant to prevent
+                raise ValueError(
+                    f"method declares concurrency_group={group!r} but the "
+                    f"actor defined groups {sorted(groups)}")
+            return ex
+        return self._actor_threads
 
     async def _h_actor_call(self, payload, conn):
         loop = asyncio.get_running_loop()
@@ -1265,7 +1289,19 @@ class Worker:
                 return {"object_id": oid.hex(), "inline": ser.to_bytes(),
                         "app_error": True}
 
-        return await loop.run_in_executor(self._actor_threads, _run)
+        try:
+            executor = self._executor_for(method)
+        except ValueError as e:
+            # surface as an application error on the return object, not a
+            # transport failure (which would look like an actor death)
+            err = exc.ActorError.capture(
+                f"{type(inst).__name__}.{method_name}", e)
+            ser = serialization.serialize_error(err)
+            oid = ObjectID.for_return(
+                TaskID(bytes.fromhex(payload["task_id"])), 0)
+            return {"object_id": oid.hex(), "inline": ser.to_bytes(),
+                    "app_error": True}
+        return await loop.run_in_executor(executor, _run)
 
 
 class _PlasmaIndirect:

@@ -193,6 +193,19 @@ def _store_actor_error(w, state: PendingTaskState, e: Exception):
     state.result_event.set()
 
 
+def _normalize_concurrency_groups(groups) -> Dict[str, int]:
+    """Accept {name: n} or the reference's [{"name":..,
+    "max_concurrency":..}] list form (actor concurrency groups)."""
+    if not groups:
+        return {}
+    if isinstance(groups, dict):
+        return {str(k): int(v) for k, v in groups.items()}
+    out = {}
+    for g in groups:
+        out[str(g["name"])] = int(g.get("max_concurrency", 1))
+    return out
+
+
 class ActorClass:
     """Result of decorating a class with ``@ray_tpu.remote``."""
 
@@ -264,6 +277,8 @@ class ActorClass:
             "class_name": self._cls.__name__,
             "init_args": arg_blob,
             "max_concurrency": opts.get("max_concurrency", 1),
+            "concurrency_groups": _normalize_concurrency_groups(
+                opts.get("concurrency_groups")),
             "runtime_env": w.prepare_runtime_env(opts.get("runtime_env")),
             "placement_group": pg,
             "job_id": w.job_id.hex(),

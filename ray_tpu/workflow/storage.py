@@ -23,6 +23,17 @@ def set_storage(root: str):
 
 
 def get_storage() -> str:
+    # explicit workflow setting wins; otherwise the cluster-wide
+    # ray_tpu.init(storage=...) root hosts a workflows/ subtree
+    if _storage_root == _DEFAULT_ROOT and \
+            "RTPU_WORKFLOW_STORAGE" not in os.environ:
+        try:
+            from ray_tpu._private.storage import get_storage_root
+            root = get_storage_root()
+            if root:
+                return os.path.join(root, "workflows")
+        except Exception:
+            pass
     return _storage_root
 
 
@@ -30,7 +41,9 @@ class WorkflowStorage:
     def __init__(self, workflow_id: str,
                  root: Optional[str] = None):
         self.workflow_id = workflow_id
-        self.dir = os.path.join(root or _storage_root, workflow_id)
+        # get_storage (NOT the raw module global): run/resume/status must
+        # agree on the cluster-wide storage root
+        self.dir = os.path.join(root or get_storage(), workflow_id)
         os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     # atomic write: temp file + rename
@@ -81,7 +94,7 @@ class WorkflowStorage:
 
 
 def list_workflows(root: Optional[str] = None) -> List[Dict[str, Any]]:
-    root = root or _storage_root
+    root = root or get_storage()
     out = []
     if not os.path.isdir(root):
         return out

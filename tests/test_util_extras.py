@@ -1,0 +1,103 @@
+"""Actor concurrency groups, check_serialize, cluster storage root.
+
+Reference analogues: test_concurrency_group.py,
+util/check_serialize tests, _private/storage tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import os
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=128 * 1024 * 1024,
+                       storage="/tmp/rtpu_storage_test")
+    yield ctx
+    ray_tpu.shutdown()
+    os.environ.pop("RTPU_STORAGE", None)
+
+
+def test_concurrency_groups_isolate(cluster):
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        def __init__(self):
+            self._evt = threading.Event()
+
+        @ray_tpu.method(concurrency_group="compute")
+        def block(self):
+            self._evt.wait(30)
+            return "done"
+
+        @ray_tpu.method(concurrency_group="io")
+        def quick(self):
+            return "io-ok"
+
+        def unblock(self):  # default group
+            self._evt.set()
+            return True
+
+    w = Worker.remote()
+    blocked = w.block.remote()
+    time.sleep(0.3)
+    # the compute group is saturated by block(); io + default groups
+    # still serve — without groups this get would deadlock until 30s
+    assert ray_tpu.get(w.quick.remote(), timeout=10) == "io-ok"
+    assert ray_tpu.get(w.unblock.remote(), timeout=10)
+    assert ray_tpu.get(blocked, timeout=30) == "done"
+
+
+def test_concurrency_groups_list_form(cluster):
+    @ray_tpu.remote(concurrency_groups=[
+        {"name": "a", "max_concurrency": 2}])
+    class W:
+        @ray_tpu.method(concurrency_group="a")
+        def f(self):
+            return 1
+
+    assert ray_tpu.get(W.remote().f.remote(), timeout=30) == 1
+
+
+def test_inspect_serializability():
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda: 42)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def bad():
+        return lock
+
+    ok, failures = inspect_serializability(bad)
+    assert not ok
+    assert any(f.obj is lock for f in failures), failures
+
+
+def test_storage_root(cluster):
+    from ray_tpu._private.storage import get_storage_root, storage_path
+    assert get_storage_root() == "/tmp/rtpu_storage_test"
+    p = storage_path("sub", "file.txt")
+    assert p.startswith("/tmp/rtpu_storage_test/")
+    # workflows default under the cluster storage root
+    import os
+    os.environ.pop("RTPU_WORKFLOW_STORAGE", None)
+    from ray_tpu.workflow.storage import get_storage
+    assert get_storage() == "/tmp/rtpu_storage_test/workflows"
+
+
+def test_unknown_concurrency_group_errors(cluster):
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class W:
+        @ray_tpu.method(concurrency_group="oops")
+        def f(self):
+            return 1
+
+    w = W.remote()
+    with pytest.raises(Exception, match="concurrency_group"):
+        ray_tpu.get(w.f.remote(), timeout=30)
