@@ -13,3 +13,5 @@ from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.train.torch_trainer import (  # noqa: F401
     TorchConfig, TorchTrainer, prepare_data_loader, prepare_model)
+from ray_tpu.train.transformers_trainer import (  # noqa: F401
+    HuggingFaceTrainer, TransformersTrainer)
