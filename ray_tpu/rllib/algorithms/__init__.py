@@ -2,6 +2,7 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, PG, PGConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
@@ -10,7 +11,8 @@ from ray_tpu.rllib.algorithms.bc import (BC, BCConfig, MARWIL,
                                          MARWILConfig)
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "ApexDQN",
-           "ApexDQNConfig", "IMPALA", "IMPALAConfig", "PG", "PGConfig",
+           "ApexDQNConfig", "IMPALA", "IMPALAConfig", "APPO",
+           "APPOConfig", "PG", "PGConfig",
            "A2C", "A2CConfig", "SAC", "SACConfig", "DDPG", "DDPGConfig",
            "TD3", "TD3Config", "BC", "BCConfig", "MARWIL",
            "MARWILConfig"]

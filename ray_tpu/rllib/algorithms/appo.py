@@ -1,0 +1,45 @@
+"""APPO — asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Reference analogue: rllib/algorithms/appo/ (appo.py, appo_torch_policy.py)
+— the IMPALA actor-learner decoupling (async samplers, learner thread,
+V-trace off-policy correction) with PPO's clipped surrogate objective on
+the V-trace advantages instead of the plain policy-gradient term.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import (IMPALA, IMPALAConfig,
+                                             IMPALAPolicy)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class APPOPolicy(IMPALAPolicy):
+    def loss(self, params, batch):
+        dist_inputs, values, target_logp, vs, pg_adv = \
+            self._vtrace_terms(params, batch)
+        # PPO clip on the V-trace advantages (reference:
+        # appo_torch_policy.py loss — the "is_ratio"/clipped surrogate)
+        clip = self.config.get("clip_param", 0.3)
+        ratio = jnp.exp(target_logp - batch[SampleBatch.ACTION_LOGP])
+        surrogate = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * pg_adv)
+        total, stats = self._assemble_loss(
+            -jnp.mean(surrogate), dist_inputs, values, vs)
+        stats["mean_is_ratio"] = jnp.mean(ratio)
+        return total, stats
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self._config.update({
+            "clip_param": 0.3,
+        })
+
+
+class APPO(IMPALA):
+    _policy_cls = APPOPolicy
+    _default_config_cls = APPOConfig

@@ -53,7 +53,9 @@ def vtrace_scan(behaviour_logp, target_logp, rewards, values, next_values,
 
 
 class IMPALAPolicy(JaxPolicy):
-    def loss(self, params, batch):
+    def _vtrace_terms(self, params, batch):
+        """Shared V-trace computation: (dist_inputs, values, target_logp,
+        vs, pg_adv). Subclasses (APPO) swap only the surrogate term."""
         cfg = self.config
         dist_inputs, values = self.model.apply(
             {"params": params}, batch[SampleBatch.OBS])
@@ -71,15 +73,26 @@ class IMPALAPolicy(JaxPolicy):
             cfg.get("gamma", 0.99),
             clip_rho=cfg.get("vtrace_clip_rho_threshold", 1.0),
             clip_c=cfg.get("vtrace_clip_c_threshold", 1.0))
-        pg_loss = -jnp.mean(target_logp * pg_adv)
+        return dist_inputs, values, target_logp, vs, pg_adv
+
+    def _assemble_loss(self, pg_loss, dist_inputs, values, vs):
+        cfg = self.config
         vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
         entropy = jnp.mean(self.dist_entropy(dist_inputs))
         total = (pg_loss
                  + cfg.get("vf_loss_coeff", 0.5) * vf_loss
                  - cfg.get("entropy_coeff", 0.01) * entropy)
         return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                       "entropy": entropy,
-                       "mean_vtrace_adv": jnp.mean(pg_adv)}
+                       "entropy": entropy}
+
+    def loss(self, params, batch):
+        dist_inputs, values, target_logp, vs, pg_adv = \
+            self._vtrace_terms(params, batch)
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        total, stats = self._assemble_loss(pg_loss, dist_inputs, values,
+                                           vs)
+        stats["mean_vtrace_adv"] = jnp.mean(pg_adv)
+        return total, stats
 
 
 class IMPALAConfig(AlgorithmConfig):

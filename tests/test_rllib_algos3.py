@@ -143,3 +143,24 @@ def test_impala_async_matches_sync_learning(cluster):
             break
     algo.cleanup()
     assert best > 60, f"async IMPALA stuck at {best}"
+
+
+def test_appo_learns_cartpole(cluster):
+    """APPO: IMPALA's async machinery with PPO's clipped surrogate."""
+    from ray_tpu.rllib.algorithms.appo import APPOConfig
+    algo = (APPOConfig().environment("CartPole-v1")
+            .rollouts(num_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=32)
+            .training(lr=3e-3, entropy_coeff=0.005,
+                      max_sample_batches_per_iter=4)
+            .debugging(seed=0).build())
+    assert algo._learner is not None  # async learner thread active
+    best = 0.0
+    for _ in range(30):
+        r = algo.step()
+        if not np.isnan(r["episode_reward_mean"]):
+            best = max(best, r["episode_reward_mean"])
+        if best > 60:
+            break
+    algo.cleanup()
+    assert best > 60, f"APPO stuck at {best}"
