@@ -101,3 +101,25 @@ def test_unknown_concurrency_group_errors(cluster):
     w = W.remote()
     with pytest.raises(Exception, match="concurrency_group"):
         ray_tpu.get(w.f.remote(), timeout=30)
+
+
+def test_joblib_backend_sklearn(cluster):
+    """GridSearchCV fans out over cluster tasks via the joblib backend
+    (reference: util/joblib ray backend)."""
+    import joblib
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((120, 5))
+    y = (X.sum(axis=1) > 0).astype(int)
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        gs = GridSearchCV(LogisticRegression(max_iter=200),
+                          {"C": [0.1, 1.0, 10.0]}, cv=3, n_jobs=4)
+        gs.fit(X, y)
+    assert gs.best_score_ > 0.8
+    assert gs.best_params_["C"] in (0.1, 1.0, 10.0)
