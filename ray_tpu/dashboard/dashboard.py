@@ -63,6 +63,12 @@ class DashboardActor:
                     self._json(500, {"error": repr(e)})
 
             def do_POST(self):
+                self._with_body("POST")
+
+            def do_PUT(self):
+                self._with_body("PUT")
+
+            def _with_body(self, method: str):
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     body = json.loads(self.rfile.read(n) or b"{}") \
@@ -70,7 +76,7 @@ class DashboardActor:
                 except Exception as e:
                     return self._json(400, {"error": f"bad body: {e!r}"})
                 try:
-                    self._route("POST", body)
+                    self._route(method, body)
                 except Exception as e:
                     self._json(500, {"error": repr(e)})
 
@@ -121,6 +127,17 @@ class DashboardActor:
                         return self._text(200, state.get_log(m.group(1)))
                     except (ValueError, OSError) as e:
                         return self._json(404, {"error": str(e)})
+                if path == "/api/serve/applications":
+                    from ray_tpu import serve as _serve
+                    if method == "PUT":
+                        # declarative deploy (reference: serve REST API,
+                        # PUT /api/serve/applications/)
+                        from ray_tpu.serve.schema import deploy_config
+                        names = deploy_config(body or {})
+                        return self._json(200, {"deployed": names})
+                    return self._json(200, {
+                        "applications": _serve.list_applications(),
+                        "deployments": _serve.status()})
                 client = JobSubmissionClient()
                 if path in ("/api/jobs", "/api/jobs/"):
                     if method == "POST":

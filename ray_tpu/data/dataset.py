@@ -400,6 +400,34 @@ class Dataset:
                     yield BlockAccessor.for_block(last).to_batch(
                         batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, drop_last: bool = False,
+                           local_shuffle_buffer_size: Optional[int] = None,
+                           local_shuffle_seed: Optional[int] = None,
+                           prefetch_blocks: int = 1) -> Iterator[Any]:
+        """iter_batches with columns converted to torch tensors
+        (reference: Dataset.iter_torch_batches). ``dtypes`` maps column
+        name -> torch dtype (or one dtype for all)."""
+        import torch
+
+        def _to_torch(col, name):
+            t = torch.as_tensor(np.ascontiguousarray(col))
+            if dtypes is None:
+                return t
+            want = dtypes.get(name) if isinstance(dtypes, dict) else dtypes
+            return t.to(want) if want is not None else t
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                local_shuffle_seed=local_shuffle_seed,
+                prefetch_blocks=prefetch_blocks):
+            if isinstance(batch, dict):
+                yield {k: _to_torch(v, k) for k, v in batch.items()}
+            else:
+                yield _to_torch(batch, VALUE_COL)
+
     def iter_device_batches(self, *, batch_size: int = 256,
                             sharding=None, dtypes=None,
                             drop_last: bool = False,
@@ -432,16 +460,6 @@ class Dataset:
             prev = cur
         if prev is not None:
             yield prev
-
-    def iter_torch_batches(self, *, batch_size: int = 256, **kw
-                           ) -> Iterator[Any]:
-        import torch
-        for batch in self.iter_batches(batch_size=batch_size,
-                                       batch_format="numpy", **kw):
-            if isinstance(batch, dict):
-                yield {k: torch.as_tensor(v) for k, v in batch.items()}
-            else:
-                yield torch.as_tensor(batch)
 
     def to_pandas(self):
         import pandas as pd

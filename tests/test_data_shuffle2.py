@@ -94,3 +94,50 @@ def test_stats_records_stages(cluster):
     s = ds.stats()
     assert "map" in s and "random_shuffle" in s
     assert "blocks" in s
+
+
+def test_iter_torch_batches(cluster):
+    import torch
+
+    ds = rdata.from_numpy({"x": np.arange(20, dtype=np.float64),
+                           "y": np.ones(20, np.int32)})
+    batches = list(ds.iter_torch_batches(
+        batch_size=8, dtypes={"x": torch.float32}))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    assert batches[0]["x"].dtype == torch.float32
+    assert batches[0]["y"].dtype == torch.int32
+    assert sum(len(b["x"]) for b in batches) == 20
+
+
+def test_serve_rest_on_dashboard(cluster):
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    port = start_dashboard(port=18266)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/serve/applications",
+        method="PUT",
+        data=_json.dumps({
+            "http_options": {"port": 8127},
+            "applications": [{
+                "name": "rest_app",
+                "import_path": "tests.serve_test_app:app",
+                "route_prefix": "/rest",
+            }],
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    out = _json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out["deployed"] == ["rest_app"]
+    status = _json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/serve/applications",
+        timeout=30).read())
+    assert "rest_app" in status["applications"]
+    body = _json.dumps({"k": 5}).encode()
+    resp = _json.loads(urllib.request.urlopen(urllib.request.Request(
+        "http://127.0.0.1:8127/rest", data=body,
+        headers={"Content-Type": "application/json"}),
+        timeout=30).read())
+    assert resp == {"cfg_echo": {"k": 5}}
+    from ray_tpu import serve
+    serve.shutdown()
