@@ -84,11 +84,18 @@ def test_storage_root(cluster):
     assert get_storage_root() == "/tmp/rtpu_storage_test"
     p = storage_path("sub", "file.txt")
     assert p.startswith("/tmp/rtpu_storage_test/")
-    # workflows default under the cluster storage root
+    # workflows default under the cluster storage root (reset any
+    # explicit set_storage() a previous test applied — an explicit
+    # setting rightly takes precedence over the cluster root)
     import os
     os.environ.pop("RTPU_WORKFLOW_STORAGE", None)
-    from ray_tpu.workflow.storage import get_storage
-    assert get_storage() == "/tmp/rtpu_storage_test/workflows"
+    from ray_tpu.workflow import storage as ws
+    old_root = ws._storage_root
+    ws._storage_root = ws._DEFAULT_ROOT
+    try:
+        assert ws.get_storage() == "/tmp/rtpu_storage_test/workflows"
+    finally:
+        ws._storage_root = old_root
 
 
 def test_unknown_concurrency_group_errors(cluster):
