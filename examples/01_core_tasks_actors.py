@@ -1,0 +1,31 @@
+"""Core API: tasks, actors, objects (run: python examples/01_core_tasks_actors.py)."""
+import numpy as np
+
+import ray_tpu
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def preprocess(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class Accumulator:
+        def __init__(self):
+            self.total = 0.0
+
+        def add(self, arr):
+            self.total += float(np.sum(arr))
+            return self.total
+
+    big = ray_tpu.put(np.ones((1024, 1024), np.float32))  # plasma, zero-copy reads
+    acc = Accumulator.remote()
+    doubled = preprocess.remote(big)
+    print("total:", ray_tpu.get(acc.add.remote(doubled)))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

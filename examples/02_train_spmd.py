@@ -1,0 +1,47 @@
+"""Train: DataParallelTrainer running a jitted SPMD step on the gang
+(run: python examples/02_train_spmd.py)."""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.train import (DataParallelTrainer, ScalingConfig, report,
+                           get_dataset_shard)
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(w, batch):
+        x, y = batch["x"], batch["y"]
+        pred = x @ w
+        loss = jnp.mean((pred - y) ** 2)
+        grad = 2 * x.T @ (pred - y) / len(x)
+        return w - config["lr"] * grad, loss
+
+    w = jnp.zeros((4,))
+    shard = get_dataset_shard("train")
+    for epoch in range(config["epochs"]):
+        for batch in shard.iter_batches(batch_size=32,
+                                        batch_format="numpy"):
+            w, loss = step(w, batch)
+        report({"epoch": epoch, "loss": float(loss)})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    ds = rdata.from_numpy({"x": x, "y": (x @ [1, -2, 3, 0.5]).astype(np.float32)})
+    trainer = DataParallelTrainer(
+        train_loop, train_loop_config={"lr": 0.1, "epochs": 3},
+        datasets={"train": ds},
+        scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    print("final:", result.metrics)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Tune: TPE search with ASHA early stopping
+(run: python examples/03_tune_search.py)."""
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import session
+
+
+def objective(config):
+    acc = 0.0
+    for i in range(20):
+        acc += config["lr"] * (1.0 - acc)  # toy convergence curve
+        session.report({"accuracy": acc})
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="accuracy", mode="max", num_samples=12,
+            search_alg=tune.TPESearcher(num_samples=12, seed=0),
+            scheduler=tune.ASHAScheduler(max_t=20, grace_period=4)))
+    best = tuner.fit().get_best_result()
+    print("best lr:", best.config["lr"], "acc:", best.metrics["accuracy"])
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Serve: a two-route deployment graph behind the HTTP proxy
+(run: python examples/04_serve_graph.py, then curl the printed URLs)."""
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.drivers import DAGDriver
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x=0):
+        return {"doubled": 2 * x}
+
+
+@serve.deployment(num_replicas=2)
+class Negator:
+    def __call__(self, x=0):
+        return {"negated": -x}
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    app = DAGDriver.bind({"/double": Doubler.bind(),
+                          "/negate": Negator.bind()})
+    serve.run(app, http_port=8000)
+    print("POST http://127.0.0.1:8000/double  {'x'-less JSON body = arg}")
+    print("POST http://127.0.0.1:8000/negate")
+    input("serving; press enter to stop\n")
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

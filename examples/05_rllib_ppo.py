@@ -1,0 +1,26 @@
+"""RLlib: PPO on CartPole with evaluation workers
+(run: python examples/05_rllib_ppo.py)."""
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_envs_per_worker=8, rollout_fragment_length=64)
+            .training(train_batch_size=512, lr=3e-3)
+            .evaluation(evaluation_interval=5, evaluation_num_episodes=3)
+            .debugging(seed=0)
+            .build())
+    for i in range(20):
+        r = algo.step()
+        print(f"iter {i}: reward={r['episode_reward_mean']:.1f}")
+        if r.get("evaluation"):
+            print("  eval:", r["evaluation"]["episode_reward_mean"])
+    algo.cleanup()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Examples stay importable (full runs live in the component suites)."""
+
+import ast
+import os
+
+import pytest
+
+EX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("fname", sorted(os.listdir(EX)))
+def test_example_parses(fname):
+    if not fname.endswith(".py"):
+        pytest.skip("not python")
+    with open(os.path.join(EX, fname)) as f:
+        tree = ast.parse(f.read(), filename=fname)
+    # every example is a main()-guarded script
+    names = {n.name for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef)}
+    assert "main" in names
