@@ -91,10 +91,16 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __del__(self):
+        # GC can fire INSIDE a region that already holds the refcount
+        # lock (observed: a dict resize in add_local triggered GC, which
+        # collected a ref whose __del__ then re-took the non-reentrant
+        # lock — a self-deadlock). __del__ therefore only enqueues; the
+        # actual decrement runs from normal code paths.
         w = self._worker
         if w is not None and w.connected:
             try:
-                w.reference_counter.remove_local(self._id, self._owner_address)
+                w.reference_counter.defer_remove_local(
+                    self._id, self._owner_address)
             except Exception:
                 pass
 
@@ -165,6 +171,23 @@ class ReferenceCounter:
         self.lock = threading.Lock()
         # oid -> [local, submitted, borrowers:set, owned:bool, spec|None]
         self.table: Dict[ObjectID, Dict[str, Any]] = {}
+        # removals queued by ObjectRef.__del__ (GC-safe: deque.append is
+        # atomic and takes no lock); drained by drain_deferred()
+        import collections
+        self._deferred: "collections.deque" = collections.deque()
+
+    def defer_remove_local(self, oid: ObjectID, owner_address: str):
+        self._deferred.append((oid, owner_address))
+
+    def drain_deferred(self):
+        """Apply queued __del__ decrements. Called from ordinary (non-GC)
+        code paths and a periodic io-loop tick; O(1) when empty."""
+        while self._deferred:
+            try:
+                oid, owner = self._deferred.popleft()
+            except IndexError:
+                return
+            self.remove_local(oid, owner)
 
     def _entry(self, oid: ObjectID):
         return self.table.setdefault(oid, {
@@ -435,6 +458,17 @@ class Worker:
         elif job_id is not None:
             self.job_id = job_id
         self.connected = True
+
+        # periodic drain of GC-deferred ref removals (ObjectRef.__del__
+        # only enqueues — see ReferenceCounter.drain_deferred)
+        async def _drain_loop():
+            while self.connected:
+                await asyncio.sleep(1.0)
+                try:
+                    self.reference_counter.drain_deferred()
+                except Exception:
+                    pass
+        self.io.run_async(_drain_loop())
         _global_worker = self
 
     def disconnect(self):
@@ -555,6 +589,7 @@ class Worker:
                    ) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed")
+        self.reference_counter.drain_deferred()
         oid = self.next_put_id()
         ser = serialization.serialize(value)
         self._store_serialized(oid, ser)
@@ -624,6 +659,7 @@ class Worker:
 
     def get_objects(self, refs: List[ObjectRef],
                     timeout: Optional[float] = None) -> List[Any]:
+        self.reference_counter.drain_deferred()
         deadline = None if timeout is None else time.monotonic() + timeout
         return [self._get_one(ref, deadline) for ref in refs]
 

@@ -212,3 +212,24 @@ def test_actor_call_with_temporary_put_ref(ray_start_shared):
     assert ray_tpu.get(h.ping.remote(), timeout=30) == "ok"
     assert ray_tpu.get(h.set_w.remote(ray_tpu.put(big * 2)),
                        timeout=30) == float(big.sum() * 2)
+
+
+def test_gc_during_refcount_no_deadlock(ray_start_shared):
+    """GC firing inside refcount critical sections must not deadlock:
+    ObjectRef.__del__ only defers its decrement (regression for a
+    GC-in-add_local self-deadlock caught in the full-suite run)."""
+    import gc
+
+    old = gc.get_threshold()
+    gc.set_threshold(1, 1, 1)  # collect on almost every allocation
+    try:
+        for i in range(200):
+            refs = [ray_tpu.put((i, j)) for j in range(5)]
+            assert ray_tpu.get(refs, timeout=60) == [(i, j)
+                                                    for j in range(5)]
+            del refs
+    finally:
+        gc.set_threshold(*old)
+    # deferred decrements eventually apply
+    w = ray_tpu._worker_mod.global_worker()
+    w.reference_counter.drain_deferred()
