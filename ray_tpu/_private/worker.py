@@ -23,6 +23,7 @@ driver or worker — embeds one ``Worker``:
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import queue
@@ -173,8 +174,7 @@ class ReferenceCounter:
         self.table: Dict[ObjectID, Dict[str, Any]] = {}
         # removals queued by ObjectRef.__del__ (GC-safe: deque.append is
         # atomic and takes no lock); drained by drain_deferred()
-        import collections
-        self._deferred: "collections.deque" = collections.deque()
+        self._deferred: collections.deque = collections.deque()
 
     def defer_remove_local(self, oid: ObjectID, owner_address: str):
         self._deferred.append((oid, owner_address))
@@ -472,6 +472,13 @@ class Worker:
         _global_worker = self
 
     def disconnect(self):
+        # flush deferred decrements BEFORE teardown: the borrow_del/free
+        # notifies for refs dropped in the last drain interval must still
+        # reach their owners or they leak cluster-wide
+        try:
+            self.reference_counter.drain_deferred()
+        except Exception:
+            pass
         self.connected = False
         if self._server is not None:
             self._server.close()

@@ -230,6 +230,13 @@ def test_gc_during_refcount_no_deadlock(ray_start_shared):
             del refs
     finally:
         gc.set_threshold(*old)
-    # deferred decrements eventually apply
+    # deferred decrements actually APPLY: after draining, the dropped
+    # put-ids are gone from the refcount table
     w = ray_tpu._worker_mod.global_worker()
     w.reference_counter.drain_deferred()
+    assert not w.reference_counter._deferred
+    import gc as _gc
+    _gc.collect()
+    w.reference_counter.drain_deferred()
+    remaining = len(w.reference_counter.table)
+    assert remaining < 50, f"refcount table leaked: {remaining} entries"
