@@ -1,18 +1,35 @@
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.algorithms.apex_ddpg import ApexDDPG, ApexDDPGConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, PG, PGConfig
+from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.ddpg import (DDPG, DDPGConfig, TD3,
                                            TD3Config)
 from ray_tpu.rllib.algorithms.bc import (BC, BCConfig, MARWIL,
                                          MARWILConfig)
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
+from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
+                                             BanditLinTSConfig,
+                                             BanditLinUCB,
+                                             BanditLinUCBConfig)
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "ApexDQN",
-           "ApexDQNConfig", "IMPALA", "IMPALAConfig", "APPO",
+__all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
+           "DQNConfig", "SimpleQ", "SimpleQConfig", "ApexDQN",
+           "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
+           "IMPALA", "IMPALAConfig", "APPO",
            "APPOConfig", "PG", "PGConfig",
-           "A2C", "A2CConfig", "SAC", "SACConfig", "DDPG", "DDPGConfig",
+           "A2C", "A2CConfig", "A3C", "A3CConfig",
+           "SAC", "SACConfig", "DDPG", "DDPGConfig",
            "TD3", "TD3Config", "BC", "BCConfig", "MARWIL",
-           "MARWILConfig"]
+           "MARWILConfig", "CQL", "CQLConfig", "CRR", "CRRConfig",
+           "ES", "ESConfig", "ARS", "ARSConfig",
+           "BanditLinUCB", "BanditLinUCBConfig",
+           "BanditLinTS", "BanditLinTSConfig"]

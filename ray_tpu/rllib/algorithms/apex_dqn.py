@@ -49,49 +49,37 @@ class _ReplayShard:
 ReplayShard = ray_tpu.remote(_ReplayShard)
 
 
-class ApexDQNConfig(DQNConfig):
-    def __init__(self, algo_class=None):
-        super().__init__(algo_class or ApexDQN)
-        self._config.update({
-            "num_workers": 2,
-            "prioritized_replay": True,
-            "epsilon_base": 0.4,  # per-worker ladder: base^(1+7i/(N-1))
-            "replay_prefetch": 2,  # sample futures kept in flight
-            "train_batch_size": 64,
-            "rollout_fragment_length": 16,
-            "learning_starts": 500,
-            "target_network_update_freq": 1000,
-            "max_sample_batches_per_iter": 8,
-            "train_intensity_per_iter": 4,
-        })
+class ApexLoopMixin:
+    """The Ape-X orchestration, shared by ApexDQN and ApexDDPG
+    (reference: apex_dqn.py and apex_ddpg.py share ApexDQN.training_step
+    the same way). Subclasses provide ``_worker_exploration(i, n)`` —
+    the per-worker exploration ladder — and a policy whose learn stats
+    include per-sample ``td_errors``."""
 
+    def _worker_exploration(self, i: int, n: int) -> Dict[str, Any]:
+        raise NotImplementedError
 
-class ApexDQN(DQN):
-    """DQN with a replay actor between samplers and the learner."""
-
-    _default_config_cls = ApexDQNConfig
-
-    def setup(self, config):
-        super().setup(config)
+    def _apex_setup(self):
         cfg = self.config
         if not self.workers.remote_workers:
-            raise ValueError("ApexDQN requires num_workers >= 1")
+            raise ValueError(
+                f"{type(self).__name__} requires num_workers >= 1")
         self.replay_actor = ReplayShard.remote(
             cfg["replay_buffer_capacity"],
             cfg["prioritized_replay_alpha"], cfg.get("seed"))
-        # fixed per-worker epsilon ladder (no annealing — the ladder IS
-        # the exploration schedule in Ape-X)
+        # fixed per-worker exploration ladder (no annealing — the ladder
+        # IS the exploration schedule in Ape-X)
         n = len(self.workers.remote_workers)
-        base = cfg.get("epsilon_base", 0.4)
         for i, w in enumerate(self.workers.remote_workers):
-            eps = base ** (1 + 7 * i / max(1, n - 1))
-            w.set_exploration.remote(exploration_epsilon=eps)
-        self.workers.local_worker.policy.exploration_epsilon = 0.0
+            w.set_exploration.remote(**self._worker_exploration(i, n))
         self._sample_futs: Dict[Any, Any] = {}  # sample fut -> worker
         self._replay_futs: list = []  # prefetched train-batch futures
         self._replay_size = 0
         self._steps_since_target_sync = 0
         self._learn_count = 0
+        # the ReplayShard actor replaces the driver-local buffer the
+        # DQN/DDPG base setup allocated — drop the dead state
+        self.replay = None
 
     def _launch_sample(self, worker):
         fut = worker.sample.remote()
@@ -150,7 +138,10 @@ class ApexDQN(DQN):
                 self.replay_actor.update_priorities.remote(
                     train["batch_indexes"], stats.pop("td_errors"))
                 self._steps_since_target_sync += train.count
-                if (self._steps_since_target_sync
+                # hard target sync by period (DQN); DDPG/TD3 polyak
+                # inside learn_on_batch and have no update_target
+                if (hasattr(policy, "update_target")
+                        and self._steps_since_target_sync
                         >= cfg["target_network_update_freq"]):
                     policy.update_target()
                     self._steps_since_target_sync = 0
@@ -170,3 +161,35 @@ class ApexDQN(DQN):
         except Exception:
             pass
         super().cleanup()
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDQN)
+        self._config.update({
+            "num_workers": 2,
+            "prioritized_replay": True,
+            "epsilon_base": 0.4,  # per-worker ladder: base^(1+7i/(N-1))
+            "replay_prefetch": 2,  # sample futures kept in flight
+            "train_batch_size": 64,
+            "rollout_fragment_length": 16,
+            "learning_starts": 500,
+            "target_network_update_freq": 1000,
+            "max_sample_batches_per_iter": 8,
+            "train_intensity_per_iter": 4,
+        })
+
+
+class ApexDQN(ApexLoopMixin, DQN):
+    """DQN with a replay actor between samplers and the learner."""
+
+    _default_config_cls = ApexDQNConfig
+
+    def _worker_exploration(self, i, n):
+        base = self.config.get("epsilon_base", 0.4)
+        return {"exploration_epsilon": base ** (1 + 7 * i / max(1, n - 1))}
+
+    def setup(self, config):
+        super().setup(config)
+        self._apex_setup()
+        self.workers.local_worker.policy.exploration_epsilon = 0.0

@@ -1,0 +1,189 @@
+"""CQL — conservative Q-learning for offline RL.
+
+Reference analogue: rllib/algorithms/cql/ (cql.py, cql_torch_policy.py):
+SAC's actor/critic/alpha machinery plus a conservative penalty on the
+critic — logsumexp over sampled actions (uniform + policy, with
+importance corrections) minus the dataset Q — and an initial
+behavior-cloning phase for the actor (``bc_iters``). Trains purely from
+a JsonReader dataset; the env is used only for evaluation.
+
+The whole update (SAC core + penalty, both phases) is ONE jitted
+program: the BC→SAC actor switch is a traced scalar weight, not a
+Python branch, so the executable never recompiles mid-training.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import (SAC, SACConfig, SACPolicy,
+                                          _SACNets, _dataset_action_logp,
+                                          _squash)
+from ray_tpu.rllib.offline import (OfflineAlgorithmMixin,
+                                   OfflineDataConfigMixin)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CQLPolicy(SACPolicy):
+    def _q_many(self, params, obs, acts):
+        """Q(s, a_i) for N action samples: (N, B, d) -> two (N, B)."""
+        n, b, d = acts.shape
+        obs_rep = jnp.broadcast_to(obs[None], (n, b, obs.shape[-1]))
+        q1, q2 = self.model.apply(
+            {"params": params}, obs_rep.reshape(n * b, -1),
+            acts.reshape(n * b, d), method=_SACNets.q)
+        return q1.reshape(n, b), q2.reshape(n, b)
+
+    def _update_impl(self, params, target_params, log_alpha, opt_state,
+                     batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        alpha_cql = cfg.get("cql_alpha", 1.0)
+        n_samp = cfg.get("cql_num_actions", 4)
+        target_entropy = -float(self.act_dim)
+        obs = batch[SampleBatch.OBS]
+        nobs = batch[SampleBatch.NEXT_OBS]
+        acts = batch["raw_actions"]
+        rews = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+        # 1.0 during the BC phase, 0.0 after (traced, no recompile)
+        bc_w = batch["_bc_weight"]
+        rngs = jax.random.split(rng, 5)
+
+        # SAC target Q
+        mean_n, log_std_n = self.model.apply(
+            {"params": target_params}, nobs, method=_SACNets.pi)
+        next_a, next_logp = _squash(mean_n, log_std_n, rngs[0])
+        tq1, tq2 = self.model.apply({"params": target_params}, nobs,
+                                    next_a, method=_SACNets.q)
+        alpha = jnp.exp(log_alpha)
+        target_q = rews + gamma * not_done * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def sample_n(p, o, key):
+            """(N, B) actions + logps from the current policy at obs o."""
+            mean, log_std = self.model.apply({"params": p}, o,
+                                             method=_SACNets.pi)
+            def one(k):
+                return _squash(mean, log_std, k)
+            a, lp = jax.vmap(one)(jax.random.split(key, n_samp))
+            return a, lp
+
+        def loss_fn(trainables):
+            p, la = trainables
+            q1, q2 = self.model.apply({"params": p}, obs, acts,
+                                      method=_SACNets.q)
+            bellman = jnp.mean((q1 - target_q) ** 2
+                               + (q2 - target_q) ** 2)
+
+            # conservative penalty: logsumexp over uniform + policy +
+            # next-policy actions with importance corrections
+            # (cql_torch_policy.py; Kumar et al. Eq. 4 w/ IS)
+            b = obs.shape[0]
+            rand_a = jax.random.uniform(
+                rngs[1], (n_samp, b, self.act_dim), minval=-1.0,
+                maxval=1.0)
+            pi_a, pi_logp = sample_n(p, obs, rngs[2])
+            npi_a, npi_logp = sample_n(p, nobs, rngs[3])
+            rq1, rq2 = self._q_many(p, obs, rand_a)
+            pq1, pq2 = self._q_many(p, obs, pi_a)
+            nq1, nq2 = self._q_many(p, obs, npi_a)
+            log_unif = -self.act_dim * jnp.log(2.0)  # density of U(-1,1)^d
+
+            def cat_lse(rq, pq, nq):
+                cat = jnp.concatenate([
+                    rq - log_unif,
+                    pq - jax.lax.stop_gradient(pi_logp),
+                    nq - jax.lax.stop_gradient(npi_logp)], axis=0)
+                return jax.scipy.special.logsumexp(
+                    cat, axis=0) - jnp.log(3 * n_samp)
+
+            penalty = (jnp.mean(cat_lse(rq1, pq1, nq1) - q1)
+                       + jnp.mean(cat_lse(rq2, pq2, nq2) - q2))
+            critic_loss = bellman + alpha_cql * penalty
+
+            # actor: BC warmup cross-fading into the SAC objective
+            mean, log_std = self.model.apply({"params": p}, obs,
+                                             method=_SACNets.pi)
+            new_a, new_logp = _squash(mean, log_std, rngs[4])
+            frozen_p = jax.lax.stop_gradient(p)
+            fq1, fq2 = self.model.apply({"params": frozen_p}, obs, new_a,
+                                        method=_SACNets.q)
+            sac_actor = jnp.mean(
+                jnp.exp(jax.lax.stop_gradient(la)) * new_logp
+                - jnp.minimum(fq1, fq2))
+            data_logp = _dataset_action_logp(acts, mean, log_std)
+            bc_actor = jnp.mean(
+                jnp.exp(jax.lax.stop_gradient(la)) * new_logp - data_logp)
+            actor_loss = bc_w * bc_actor + (1.0 - bc_w) * sac_actor
+
+            alpha_loss = -jnp.mean(
+                la * jax.lax.stop_gradient(new_logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "bellman_loss": bellman,
+                           "cql_penalty": penalty,
+                           "actor_loss": actor_loss,
+                           "alpha": jnp.exp(la),
+                           "mean_q": jnp.mean(q1)}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)((params, log_alpha))
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, (params, log_alpha))
+        params, log_alpha = optax.apply_updates((params, log_alpha),
+                                                updates)
+        tau = cfg.get("tau", 0.005)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+        stats = dict(stats)
+        stats["total_loss"] = loss_val
+        return params, target_params, log_alpha, opt_state, stats
+
+
+class CQLConfig(OfflineDataConfigMixin, SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self._config.update({
+            "input_path": None,
+            "cql_alpha": 1.0,
+            "cql_num_actions": 4,
+            "bc_iters": 200,  # actor BC warmup learn-steps
+            "train_batch_size": 256,
+            "num_iters_per_step": 10,
+        })
+
+
+class CQL(OfflineAlgorithmMixin, Algorithm):
+    _policy_cls = CQLPolicy
+    _default_config_cls = CQLConfig
+
+    def setup(self, config):
+        super().setup(config)
+        self._load_offline_dataset()
+        self._learn_steps = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        cfg = self.config
+        bs = cfg["train_batch_size"]
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.get("num_iters_per_step", 10)):
+            mb = self._offline_minibatch(bs)
+            mb["_bc_weight"] = np.full(
+                (), 1.0 if self._learn_steps < cfg["bc_iters"] else 0.0,
+                np.float32)
+            stats = policy.learn_on_batch(mb)
+            self._learn_steps += 1
+            self._timesteps_total += bs
+        self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": 0,
+                "learn_steps_total": self._learn_steps,
+                **{f"learner/{k}": v for k, v in stats.items()}}

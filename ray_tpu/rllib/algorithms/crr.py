@@ -1,0 +1,137 @@
+"""CRR — critic-regularized regression for offline RL.
+
+Reference analogue: rllib/algorithms/crr/ (crr.py, torch/crr_torch_policy
+.py; Wang et al. 2020): the critic learns by standard TD on the dataset;
+the actor is advantage-weighted behavior cloning — log-prob of dataset
+actions weighted by f(A(s,a)) where the advantage baseline is the mean Q
+over policy samples, and f is ``binary`` (indicator A>0) or ``exp``
+(clipped exp(A/beta)). No environment interaction; same SAC net layout
+(stochastic squashed-Gaussian actor + twin critics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import (SACConfig, SACPolicy,
+                                          _SACNets, _dataset_action_logp,
+                                          _squash)
+from ray_tpu.rllib.offline import (OfflineAlgorithmMixin,
+                                   OfflineDataConfigMixin)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CRRPolicy(SACPolicy):
+    def _update_impl(self, params, target_params, log_alpha, opt_state,
+                     batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        beta = cfg.get("temperature", 1.0)
+        n_samp = cfg.get("advantage_num_actions", 4)
+        weight_type = cfg.get("weight_type", "exp")  # static: py branch ok
+        obs = batch[SampleBatch.OBS]
+        nobs = batch[SampleBatch.NEXT_OBS]
+        acts = batch["raw_actions"]
+        rews = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.DONES].astype(jnp.float32)
+        rngs = jax.random.split(rng, 3)
+
+        # TD target from the target nets + target policy sample
+        mean_n, log_std_n = self.model.apply(
+            {"params": target_params}, nobs, method=_SACNets.pi)
+        next_a, _ = _squash(mean_n, log_std_n, rngs[0])
+        tq1, tq2 = self.model.apply({"params": target_params}, nobs,
+                                    next_a, method=_SACNets.q)
+        target_q = rews + gamma * not_done * jnp.minimum(tq1, tq2)
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def loss_fn(trainables):
+            p, _la = trainables
+            q1, q2 = self.model.apply({"params": p}, obs, acts,
+                                      method=_SACNets.q)
+            critic_loss = jnp.mean((q1 - target_q) ** 2
+                                   + (q2 - target_q) ** 2)
+
+            # advantage baseline: mean Q over n policy samples at s
+            mean, log_std = self.model.apply({"params": p}, obs,
+                                             method=_SACNets.pi)
+            def one(k):
+                a, _ = _squash(mean, log_std, k)
+                fq1, fq2 = self.model.apply(
+                    {"params": jax.lax.stop_gradient(p)}, obs, a,
+                    method=_SACNets.q)
+                return jnp.minimum(fq1, fq2)
+            v_est = jnp.mean(
+                jax.vmap(one)(jax.random.split(rngs[1], n_samp)), axis=0)
+            adv = jax.lax.stop_gradient(
+                jnp.minimum(q1, q2) - v_est)
+            if weight_type == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / beta),
+                                cfg.get("max_weight", 20.0))
+            w = jax.lax.stop_gradient(w)
+
+            data_logp = _dataset_action_logp(acts, mean, log_std)
+            actor_loss = -jnp.mean(w * data_logp)
+
+            total = critic_loss + actor_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "mean_weight": jnp.mean(w),
+                           "mean_advantage": jnp.mean(adv),
+                           "mean_q": jnp.mean(q1)}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)((params, log_alpha))
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, (params, log_alpha))
+        params, log_alpha = optax.apply_updates((params, log_alpha),
+                                                updates)
+        tau = cfg.get("tau", 0.005)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+        stats = dict(stats)
+        stats["total_loss"] = loss_val
+        return params, target_params, log_alpha, opt_state, stats
+
+
+class CRRConfig(OfflineDataConfigMixin, SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CRR)
+        self._config.update({
+            "input_path": None,
+            "weight_type": "exp",  # or "binary"
+            "temperature": 1.0,
+            "max_weight": 20.0,
+            "advantage_num_actions": 4,
+            "train_batch_size": 256,
+            "num_iters_per_step": 10,
+        })
+
+
+class CRR(OfflineAlgorithmMixin, Algorithm):
+    _policy_cls = CRRPolicy
+    _default_config_cls = CRRConfig
+
+    def setup(self, config):
+        super().setup(config)
+        self._load_offline_dataset()
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        cfg = self.config
+        bs = cfg["train_batch_size"]
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.get("num_iters_per_step", 10)):
+            stats = policy.learn_on_batch(self._offline_minibatch(bs))
+            self._timesteps_total += bs
+        self.workers.sync_weights()
+        return {"num_env_steps_sampled_this_iter": 0,
+                **{f"learner/{k}": v for k, v in stats.items()}}

@@ -19,6 +19,7 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import _stats_to_host
 from ray_tpu.rllib.env import Box
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 from ray_tpu.rllib.rollout_worker import synchronous_parallel_sample
@@ -147,12 +148,16 @@ class DDPGPolicy:
         def critic_loss_fn(p):
             q1, q2 = self.model.apply({"params": p}, obs, acts,
                                       method=_DDPGNets.q)
-            loss = jnp.mean((q1 - target_q) ** 2)
+            # importance weights from prioritized replay (Ape-X DDPG)
+            w = batch.get("weights", jnp.ones_like(q1))
+            loss = jnp.mean(w * (q1 - target_q) ** 2)
             if cfg.get("twin_q", False):
-                loss = loss + jnp.mean((q2 - target_q) ** 2)
+                loss = loss + jnp.mean(w * (q2 - target_q) ** 2)
             return loss, {"mean_q": jnp.mean(q1),
                           "mean_td_error": jnp.mean(
-                              jnp.abs(q1 - target_q))}
+                              jnp.abs(q1 - target_q)),
+                          # per-sample |TD| for priority updates
+                          "td_errors": jnp.abs(q1 - target_q)}
 
         (loss_val, stats), grads = jax.value_and_grad(
             critic_loss_fn, has_aux=True)(params)
@@ -198,7 +203,7 @@ class DDPGPolicy:
             stats = dict(stats)
             stats["actor_loss"] = actor_loss
         self.global_timestep += batch.count
-        return {k: float(v) for k, v in stats.items()}
+        return _stats_to_host(stats)
 
     def value(self, obs):
         return np.zeros(len(obs), np.float32)
@@ -292,6 +297,7 @@ class DDPG(Algorithm):
                 stats = policy.learn_on_batch(
                     self.replay.sample(cfg["train_batch_size"]))
             self.workers.sync_weights()
+        stats.pop("td_errors", None)
         return {"num_env_steps_sampled_this_iter": batch.count,
                 "replay_size": len(self.replay),
                 **{f"learner/{k}": v for k, v in stats.items()}}

@@ -1,0 +1,239 @@
+"""ES — OpenAI evolution strategies (Salimans et al. 2017).
+
+Reference analogue: rllib/algorithms/es/ (es.py, optimizers.py,
+utils.py): a big shared noise table broadcast ONCE through the object
+store (zero-copy numpy from plasma on every worker — reference
+es.py create_shared_noise), antithetic perturbation rollouts on remote
+workers, centered-rank-weighted gradient estimate, Adam on the flat
+parameter vector. Evaluation/checkpointing ride the normal Algorithm
+path: the flat theta maps back onto the local JaxPolicy's pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import Discrete, make_env
+from ray_tpu.rllib.models import make_model
+from ray_tpu.rllib.policy import JaxPolicy
+
+
+def create_shared_noise(size: int, seed: int = 123) -> np.ndarray:
+    """One float32 noise pool shared by every worker (reference:
+    es.py:43 create_shared_noise — 250M floats; default here is smaller
+    and configurable via ``noise_table_size``)."""
+    return np.random.default_rng(seed).standard_normal(
+        size, dtype=np.float32)
+
+
+def compute_centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping: values → ranks in [-0.5, 0.5] (reference:
+    es/utils.py compute_centered_ranks)."""
+    ranks = np.empty(x.size, dtype=np.float32)
+    ranks[x.ravel().argsort()] = np.arange(x.size, dtype=np.float32)
+    return (ranks / max(1, x.size - 1) - 0.5).reshape(x.shape)
+
+
+class _PerturbationWorker:
+    """Holds env + shared noise; evaluates theta ± sigma·eps pairs."""
+
+    def __init__(self, config: Dict[str, Any], noise: np.ndarray,
+                 seed: int):
+        self.config = config
+        self.noise = noise
+        self.env = make_env(config["env"], config.get("env_config"))
+        self.model = make_model(self.env.observation_space,
+                                self.env.action_space,
+                                config.get("model"))
+        self.discrete = isinstance(self.env.action_space, Discrete)
+        dummy = jnp.zeros(
+            (1, *self.env.observation_space.shape), jnp.float32)
+        params = self.model.init(jax.random.PRNGKey(seed), dummy)["params"]
+        flat, self._unravel = jax.flatten_util.ravel_pytree(params)
+        self.dim = flat.size
+        self._rng = np.random.default_rng(seed)
+        self._fwd = jax.jit(self._fwd_impl)
+
+    def _fwd_impl(self, theta, obs):
+        dist_inputs, _ = self.model.apply(
+            {"params": self._unravel(theta)}, obs[None])
+        if self.discrete:
+            return jnp.argmax(dist_inputs[0])
+        mean, _ = jnp.split(dist_inputs[0], 2, axis=-1)
+        return mean
+
+    def _act(self, theta, obs):
+        a = np.asarray(self._fwd(theta, jnp.asarray(obs)))
+        if self.discrete:
+            return int(a)
+        sp = self.env.action_space
+        return np.clip(a, sp.low, sp.high).astype(np.float32)
+
+    def rollout(self, theta: np.ndarray,
+                limit: int) -> Tuple[float, int]:
+        obs, _ = self.env.reset(seed=int(self._rng.integers(2 ** 31)))
+        total, steps = 0.0, 0
+        while steps < limit:
+            obs, r, term, trunc, _ = self.env.step(self._act(theta, obs))
+            total += float(r)
+            steps += 1
+            if term or trunc:
+                break
+        return total, steps
+
+    def do_rollouts(self, theta: np.ndarray, num_pairs: int,
+                    sigma: float, limit: int) -> List[Tuple]:
+        """Antithetic pairs: [(noise_idx, r_plus, r_minus, steps)]."""
+        theta = np.asarray(theta, np.float32)
+        out = []
+        for _ in range(num_pairs):
+            idx = int(self._rng.integers(0, self.noise.size - self.dim))
+            eps = self.noise[idx:idx + self.dim]
+            rp, sp = self.rollout(theta + sigma * eps, limit)
+            rn, sn = self.rollout(theta - sigma * eps, limit)
+            out.append((idx, rp, rn, sp + sn))
+        return out
+
+
+PerturbationWorker = ray_tpu.remote(_PerturbationWorker)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ES)
+        self._config.update({
+            "num_workers": 2,
+            "sigma": 0.05,          # perturbation std (es.py noise_stdev)
+            "stepsize": 0.02,       # Adam lr on theta
+            "rollouts_per_worker": 10,  # antithetic PAIRS per worker/iter
+            "l2_coeff": 0.005,
+            "episode_horizon": 500,
+            "noise_table_size": 4_000_000,
+            "noise_seed": 123,
+        })
+
+
+class ES(Algorithm):
+    _policy_cls = JaxPolicy  # inference/checkpoint only; never .loss()
+    _default_config_cls = ESConfig
+
+    def setup(self, config):
+        base = dict(config or {})
+        self._es_num_workers = base.get(
+            "num_workers", self._default_config_cls()["num_workers"])
+        base["num_workers"] = 0  # no gradient rollout actors
+        super().setup(base)
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        flat, self._unravel = jax.flatten_util.ravel_pytree(policy.params)
+        self.theta = np.asarray(flat, np.float32)
+        self.dim = self.theta.size
+        if cfg["noise_table_size"] <= self.dim:
+            raise ValueError(
+                f"noise_table_size ({cfg['noise_table_size']}) must "
+                f"exceed the flat parameter count ({self.dim}); raise "
+                "it or shrink the model")
+        self.noise = create_shared_noise(cfg["noise_table_size"],
+                                         cfg.get("noise_seed", 123))
+        noise_ref = ray_tpu.put(self.noise)
+        seed = cfg.get("seed") or 0
+        self._es_workers = [
+            PerturbationWorker.remote(
+                {k: cfg.get(k) for k in
+                 ("env", "env_config", "model")},
+                noise_ref, seed * 1000 + i + 1)
+            for i in range(max(1, self._es_num_workers))]
+        self.optimizer = optax.adam(cfg["stepsize"])
+        self.opt_state = self.optimizer.init(self.theta)
+
+    def _gradient(self, idxs, r_pos, r_neg) -> np.ndarray:
+        # centered ranks over the FULL (pos|neg) return matrix
+        ranks = compute_centered_ranks(
+            np.stack([r_pos, r_neg], axis=1))
+        w = ranks[:, 0] - ranks[:, 1]
+        g = np.zeros(self.dim, np.float32)
+        for wi, idx in zip(w, idxs):
+            g += wi * self.noise[idx:idx + self.dim]
+        return g / (len(idxs) * self.config["sigma"])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        theta_ref = ray_tpu.put(self.theta)
+        results = ray_tpu.get([
+            w.do_rollouts.remote(theta_ref, cfg["rollouts_per_worker"],
+                                 cfg["sigma"], cfg["episode_horizon"])
+            for w in self._es_workers])
+        flat = [t for worker_out in results for t in worker_out]
+        idxs = [t[0] for t in flat]
+        r_pos = np.array([t[1] for t in flat], np.float32)
+        r_neg = np.array([t[2] for t in flat], np.float32)
+        steps = int(sum(t[3] for t in flat))
+        self._timesteps_total += steps
+
+        g = self._gradient(idxs, r_pos, r_neg)
+        g -= cfg["l2_coeff"] * self.theta  # weight decay toward 0
+        # optax minimizes: feed the negative of the ascent direction
+        updates, self.opt_state = self.optimizer.update(
+            -g, self.opt_state, self.theta)
+        self.theta = np.asarray(
+            optax.apply_updates(self.theta, updates), np.float32)
+
+        # reflect theta onto the eval/checkpoint policy
+        policy = self.workers.local_worker.policy
+        policy.params = self._unravel(jnp.asarray(self.theta))
+        all_r = np.concatenate([r_pos, r_neg])
+        self._episode_reward_window.extend(all_r.tolist())
+        return {
+            "num_env_steps_sampled_this_iter": steps,
+            "episodes_this_iter": all_r.size,
+            "perturbation_reward_mean": float(all_r.mean()),
+            "update_gnorm": float(np.linalg.norm(g)),
+        }
+
+    def cleanup(self):
+        for w in self._es_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        super().cleanup()
+
+
+class ARSConfig(ESConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ARS)
+        self._config.update({
+            "sigma": 0.05,
+            "stepsize": 0.02,
+            "rollouts_per_worker": 8,
+            # top directions kept for the update (ARS-V1t; Mania et al.)
+            "num_top_directions": 8,
+        })
+
+
+class ARS(ES):
+    """Augmented random search (reference: rllib/algorithms/ars/ars.py):
+    same worker machinery as ES; the update keeps only the top-k
+    directions by max(r+, r-) and scales by the reward std of that
+    elite set instead of fitness shaping."""
+
+    _default_config_cls = ARSConfig
+
+    def _gradient(self, idxs, r_pos, r_neg) -> np.ndarray:
+        k = min(self.config.get("num_top_directions", 8), len(idxs))
+        order = np.argsort(-np.maximum(r_pos, r_neg))[:k]
+        elite = np.concatenate([r_pos[order], r_neg[order]])
+        sigma_r = float(elite.std()) + 1e-8
+        g = np.zeros(self.dim, np.float32)
+        for i in order:
+            g += (r_pos[i] - r_neg[i]) * self.noise[
+                idxs[i]:idxs[i] + self.dim]
+        return g / (k * sigma_r)

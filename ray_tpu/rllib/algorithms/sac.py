@@ -52,6 +52,18 @@ class _SACNets(nn.Module):
         return self.pi(obs), self.q(obs, act)
 
 
+def _dataset_action_logp(acts, mean, log_std):
+    """log π(a|s) of DATASET actions under a squashed Gaussian: invert
+    the tanh, then apply the change-of-variables correction (shared by
+    the offline algorithms CQL/CRR)."""
+    pre = jnp.arctanh(jnp.clip(acts, -1.0 + 1e-6, 1.0 - 1e-6))
+    std = jnp.exp(log_std)
+    return jnp.sum(
+        -0.5 * ((pre - mean) / std) ** 2 - log_std
+        - 0.5 * jnp.log(2 * jnp.pi)
+        - jnp.log(1 - acts ** 2 + 1e-6), axis=-1)
+
+
 def _squash(mean, log_std, rng):
     std = jnp.exp(log_std)
     eps = jax.random.normal(rng, mean.shape)

@@ -60,6 +60,45 @@ class JsonWriter:
             self._file = None
 
 
+class OfflineDataConfigMixin:
+    """Fluent ``offline_data(input_path=...)`` config section shared by
+    the offline algorithm configs (reference: AlgorithmConfig
+    .offline_data())."""
+
+    def offline_data(self, *, input_path=None, **kw):
+        if input_path is not None:
+            self._config["input_path"] = input_path
+        self._config.update(kw)
+        return self
+
+
+class OfflineAlgorithmMixin:
+    """Shared offline-dataset plumbing for CQL/CRR (reference:
+    offline/json_reader.py usage inside those algorithms): load the
+    JsonReader dataset once, rescale env-space actions into the
+    policy's (-1, 1) raw space, and draw uniform minibatches."""
+
+    def _load_offline_dataset(self):
+        path = self.config.get("input_path")
+        if not path:
+            raise ValueError(
+                f"{type(self).__name__} needs config['input_path']")
+        self._data = JsonReader(path).read_all()
+        policy = self.workers.local_worker.policy
+        if "raw_actions" not in self._data:
+            a = np.asarray(self._data[SampleBatch.ACTIONS], np.float32)
+            a = a.reshape(a.shape[0], -1)
+            span = np.maximum(policy.high - policy.low, 1e-8)
+            raw = 2.0 * (a - policy.low) / span - 1.0
+            self._data["raw_actions"] = np.clip(raw, -0.999, 0.999)
+        self._rng = np.random.default_rng(self.config.get("seed"))
+
+    def _offline_minibatch(self, batch_size: int) -> SampleBatch:
+        idx = self._rng.integers(self._data.count, size=batch_size)
+        return SampleBatch(
+            {k: np.asarray(v)[idx] for k, v in self._data.items()})
+
+
 class JsonReader:
     def __init__(self, path: str):
         self.files = sorted(glob.glob(os.path.join(path, "*.json"))) \

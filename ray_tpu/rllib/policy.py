@@ -159,6 +159,35 @@ class JaxPolicy:
         self.global_timestep += batch.count
         return _stats_to_host(stats)
 
+    # ---- split grad computation/application (reference: policy.py
+    # compute_gradients/apply_gradients — the A3C-style decomposition
+    # where rollout workers compute grads and a learner applies them) ----
+
+    def _grads_impl(self, params, batch):
+        (loss_val, stats), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch)
+        stats = dict(stats)
+        stats["total_loss"] = loss_val
+        return grads, stats
+
+    def compute_gradients(self, batch: SampleBatch):
+        """Worker-side half: returns (host-numpy grad pytree, stats) —
+        shippable through the object store to the learner."""
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if isinstance(v, np.ndarray) and v.dtype != object}
+        if not hasattr(self, "_jit_grads"):
+            self._jit_grads = jax.jit(self._grads_impl)
+        grads, stats = self._jit_grads(self.params, jbatch)
+        return jax.device_get(grads), _stats_to_host(stats)
+
+    def apply_gradients(self, grads):
+        """Learner-side half: one optax update from externally computed
+        grads (same chain as ``learn_on_batch``, so clipping applies)."""
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        updates, self.opt_state = self.optimizer.update(
+            grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
     # ---- weights ----
 
     def get_weights(self) -> Dict[str, Any]:

@@ -170,9 +170,10 @@ class RolloutWorker:
         return out
 
     def apply(self, fn, *args):
-        """Run ``fn(policy, *args)`` on this worker's policy — used to
-        propagate learner-side knobs (e.g. DQN epsilon) to remote actors."""
-        return fn(self.policy, *args)
+        """Run ``fn(worker, *args)`` inside this worker (reference:
+        RolloutWorker.apply) — worker-side gradient computation (A3C),
+        local SGD (DDPPO), knob propagation."""
+        return fn(self, *args)
 
     def set_exploration(self, **attrs):
         for k, v in attrs.items():
@@ -434,7 +435,7 @@ class MultiAgentRolloutWorker:
                 setattr(p, k, v)
 
     def apply(self, fn, *args):
-        return fn(self.policy, *args)
+        return fn(self, *args)
 
     def get_policy_state(self):
         return {pid: p.get_state() for pid, p in self.policy_map.items()}

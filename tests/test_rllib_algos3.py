@@ -95,7 +95,7 @@ def test_apex_dqn_cartpole(cluster):
     assert r["num_env_steps_sampled_this_iter"] > 0
     # per-worker epsilon ladder: first worker explores least
     eps = ray_tpu.get([
-        w.apply.remote(lambda p: p.exploration_epsilon)
+        w.apply.remote(lambda w: w.policy.exploration_epsilon)
         for w in algo.workers.remote_workers])
     assert eps[0] > eps[1] or np.isclose(eps[0], 0.4), eps
     assert algo.workers.local_worker.policy.exploration_epsilon == 0.0
