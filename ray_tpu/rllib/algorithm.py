@@ -288,3 +288,80 @@ class Algorithm(Trainable):
         if self.evaluation_workers is not None:
             self.evaluation_workers.stop()
         self.workers.stop()
+
+
+class LocalAlgorithm(Algorithm):
+    """Base for algorithms that own their env loop instead of sampling
+    through a WorkerSet — QMIX's joint-transition collector, R2D2's
+    recurrent-state collector. Provides the shared driver plumbing:
+    counters, the epsilon schedule, periodic hard target sync, local
+    episode metrics, and params/target/opt checkpointing. Subclasses
+    set ``self.params/self.target_params/self.opt_state`` in setup()."""
+
+    def _init_local_state(self):
+        import jax
+        import numpy as _np
+        self.evaluation_workers = None  # Algorithm.step expects the attr
+        self._np_rng = _np.random.default_rng(self.config.get("seed"))
+        self._iteration = 0
+        self._timesteps_total = 0
+        self._steps_since_target_sync = 0
+        self._episode_reward_window: list = []
+        self._t_start = time.time()
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps_total
+                   / max(1, cfg["epsilon_timesteps"]))
+        return cfg["initial_epsilon"] + frac * (
+            cfg["final_epsilon"] - cfg["initial_epsilon"])
+
+    def _maybe_sync_target(self, steps: int):
+        import jax
+        import jax.numpy as jnp
+        self._steps_since_target_sync += steps
+        if (self._steps_since_target_sync
+                >= self.config["target_network_update_freq"]):
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+            self._steps_since_target_sync = 0
+
+    def _collect_rollout_metrics(self, window: int = 100):
+        self._episode_reward_window = \
+            self._episode_reward_window[-window:]
+        rw = self._episode_reward_window
+        return {
+            "episode_reward_mean": float(np.mean(rw)) if rw else np.nan,
+            "episode_reward_max": float(np.max(rw)) if rw else np.nan,
+            "episode_reward_min": float(np.min(rw)) if rw else np.nan,
+            "episodes_total": len(rw),
+        }
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+        return {
+            "params": jax.device_get(self.params),
+            "target_params": jax.device_get(self.target_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+        import jax.numpy as jnp
+
+        def as_jnp(t):
+            return jax.tree_util.tree_map(
+                jnp.asarray, t,
+                is_leaf=lambda x: isinstance(x, (np.ndarray,
+                                                 np.generic)))
+
+        self.params = as_jnp(state["params"])
+        self.target_params = as_jnp(state["target_params"])
+        self.opt_state = as_jnp(state["opt_state"])
+        self._iteration = state.get("iteration", 0)
+        self._timesteps_total = state.get("timesteps_total", 0)
+
+    def cleanup(self):
+        pass  # no worker actors to stop

@@ -16,6 +16,8 @@ from ray_tpu.rllib.algorithms.bc import (BC, BCConfig, MARWIL,
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
                                              BanditLinTSConfig,
                                              BanditLinUCB,
@@ -32,4 +34,5 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "MARWILConfig", "CQL", "CQLConfig", "CRR", "CRRConfig",
            "ES", "ESConfig", "ARS", "ARSConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
-           "BanditLinTS", "BanditLinTSConfig"]
+           "BanditLinTS", "BanditLinTSConfig",
+           "QMix", "QMixConfig", "R2D2", "R2D2Config"]

@@ -92,6 +92,12 @@ class CQLPolicy(SACPolicy):
                 maxval=1.0)
             pi_a, pi_logp = sample_n(p, obs, rngs[2])
             npi_a, npi_logp = sample_n(p, nobs, rngs[3])
+            # the penalty trains the CRITIC only: block the
+            # reparameterized path through the sampled actions, else
+            # minimizing the penalty pushes the actor toward LOW-Q
+            # actions (opposing the actor objective)
+            pi_a = jax.lax.stop_gradient(pi_a)
+            npi_a = jax.lax.stop_gradient(npi_a)
             rq1, rq2 = self._q_many(p, obs, rand_a)
             pq1, pq2 = self._q_many(p, obs, pi_a)
             nq1, nq2 = self._q_many(p, obs, npi_a)

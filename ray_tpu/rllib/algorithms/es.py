@@ -198,6 +198,25 @@ class ES(Algorithm):
             "update_gnorm": float(np.linalg.norm(g)),
         }
 
+    def save_checkpoint(self) -> Dict[str, Any]:
+        state = super().save_checkpoint()
+        state["theta"] = self.theta.copy()
+        state["es_opt_state"] = jax.device_get(self.opt_state)
+        return state
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        super().load_checkpoint(state)
+        if "theta" in state:
+            self.theta = np.asarray(state["theta"], np.float32)
+            self.opt_state = jax.tree_util.tree_map(
+                jnp.asarray, state["es_opt_state"],
+                is_leaf=lambda x: isinstance(x, (np.ndarray, np.generic)))
+        else:
+            # pre-theta checkpoint: re-flatten the restored policy
+            flat, _ = jax.flatten_util.ravel_pytree(
+                self.workers.local_worker.policy.params)
+            self.theta = np.asarray(flat, np.float32)
+
     def cleanup(self):
         for w in self._es_workers:
             try:

@@ -1,0 +1,331 @@
+"""QMIX — monotonic value-function factorisation for cooperative MARL.
+
+Reference analogue: rllib/algorithms/qmix/ (qmix.py, qmix_policy.py,
+model.py QMixer; Rashid et al. 2018): per-agent Q-networks (parameters
+shared across agents) whose chosen-action values are mixed into a team
+Q_tot by a hypernetwork-generated MONOTONIC mixing net conditioned on
+the global state; trained end-to-end by TD on the team reward.
+
+Joint transitions (all agents synchronized + global state) don't fit
+the per-policy split that MultiAgentRolloutWorker produces, so — like
+the reference, whose QMIX requires grouped agents and samples whole
+episodes — this algorithm owns its env loop: an epsilon-greedy joint
+collector over a cooperative MultiAgentEnv, a joint replay buffer, and
+ONE jitted update for the double-Q mixed TD loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.env import (CartPoleEnv, Discrete, MultiAgentEnv,
+                               _BUILTIN_ENVS, make_env)
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CooperativeCartPole(MultiAgentEnv):
+    """Team CartPole: episode ends when ANY pole falls; every agent
+    receives the TEAM reward (mean of alive rewards) — a minimal fully
+    cooperative env for value-decomposition tests (reference analogue:
+    the grouped TwoStepGame in rllib/examples/env/two_step_game.py)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.num_agents = int(config.get("num_agents", 2))
+        self.agent_ids = [f"agent_{i}" for i in range(self.num_agents)]
+        self._envs = {aid: CartPoleEnv() for aid in self.agent_ids}
+        e = next(iter(self._envs.values()))
+        self.observation_space = e.observation_space
+        self.action_space = e.action_space
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs, infos = {}, {}
+        for i, (aid, e) in enumerate(self._envs.items()):
+            o, info = e.reset(
+                seed=None if seed is None else seed + i)
+            obs[aid], infos[aid] = o, info
+        return obs, infos
+
+    def step(self, action_dict: Dict[Any, Any]):
+        obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+        any_term, any_trunc, team_r = False, False, 0.0
+        for aid, a in action_dict.items():
+            o, r, term, trunc, info = self._envs[aid].step(a)
+            obs[aid], infos[aid] = o, info
+            team_r += float(r)
+            any_term |= term
+            any_trunc |= trunc
+        team_r /= max(1, len(action_dict))
+        for aid in action_dict:
+            rews[aid] = team_r
+            terms[aid] = any_term
+            truncs[aid] = any_trunc
+        terms["__all__"] = any_term
+        truncs["__all__"] = any_trunc
+        return obs, rews, terms, truncs, infos
+
+
+_BUILTIN_ENVS["CoopCartPole"] = CooperativeCartPole
+
+
+class _AgentQNet(nn.Module):
+    """Shared per-agent Q-network."""
+
+    num_actions: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, obs):
+        x = nn.relu(nn.Dense(self.hidden)(obs))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_actions)(x)
+
+
+class _QMixer(nn.Module):
+    """Monotonic mixer: state-conditioned hypernetworks emit
+    NON-NEGATIVE (abs) weights so ∂Q_tot/∂Q_i ≥ 0 (reference:
+    qmix/model.py QMixer)."""
+
+    n_agents: int
+    embed: int = 32
+
+    @nn.compact
+    def __call__(self, agent_qs, state):
+        # agent_qs: (B, n), state: (B, ds)
+        b = agent_qs.shape[0]
+        w1 = jnp.abs(nn.Dense(self.n_agents * self.embed,
+                              name="hyper_w1")(state))
+        w1 = w1.reshape(b, self.n_agents, self.embed)
+        b1 = nn.Dense(self.embed, name="hyper_b1")(state)
+        hidden = nn.elu(jnp.einsum("bn,bne->be", agent_qs, w1) + b1)
+        w2 = jnp.abs(nn.Dense(self.embed, name="hyper_w2")(state))
+        b2 = nn.Dense(1, name="hyper_b2_out")(
+            nn.relu(nn.Dense(self.embed, name="hyper_b2_h")(state)))
+        return jnp.sum(hidden * w2, axis=-1) + b2[..., 0]  # (B,)
+
+
+class QMixConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or QMix)
+        self._config.update({
+            "env": "CoopCartPole",
+            "lr": 5e-4,
+            "mixer_embed": 32,
+            "agent_hidden": 64,
+            "double_q": True,
+            "replay_buffer_capacity": 50_000,
+            "learning_starts": 500,
+            "train_batch_size": 64,
+            "rollout_fragment_length": 64,
+            "target_network_update_freq": 400,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_timesteps": 8_000,
+            "training_intensity": 2,
+        })
+
+
+class QMix(LocalAlgorithm):
+    _default_config_cls = QMixConfig
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        self.env = make_env(cfg["env"], cfg.get("env_config"))
+        if not isinstance(self.env, MultiAgentEnv):
+            raise ValueError("QMIX needs a cooperative MultiAgentEnv")
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("QMIX is discrete-action only")
+        self.agent_ids = list(self.env.agent_ids)
+        self.n_agents = len(self.agent_ids)
+        self.n_actions = self.env.action_space.n
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.state_dim = self.obs_dim * self.n_agents  # concat of obs
+
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        self.qnet = _AgentQNet(self.n_actions, cfg["agent_hidden"])
+        self.mixer = _QMixer(self.n_agents, cfg["mixer_embed"])
+        k1, k2 = jax.random.split(self._next_rng())
+        dummy_obs = jnp.zeros((1, self.obs_dim))
+        self.params = {
+            "agent": self.qnet.init(k1, dummy_obs)["params"],
+            "mixer": self.mixer.init(
+                k2, jnp.zeros((1, self.n_agents)),
+                jnp.zeros((1, self.state_dim)))["params"],
+        }
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(10.0), optax.adam(cfg["lr"]))
+        self.opt_state = self.optimizer.init(self.params)
+        self._jit_q = jax.jit(self._q_impl)
+        self._jit_update = jax.jit(self._update_impl)
+
+        self.replay = ReplayBuffer(cfg["replay_buffer_capacity"],
+                                   seed=cfg.get("seed"))
+        self._init_local_state()
+        self._obs, _ = self.env.reset(seed=cfg.get("seed"))
+        self._episode_reward = 0.0
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- jitted programs ----
+
+    def _q_impl(self, agent_params, obs):
+        """obs (B, n, do) -> per-agent Q (B, n, A)."""
+        b, n, do = obs.shape
+        q = self.qnet.apply({"params": agent_params},
+                            obs.reshape(b * n, do))
+        return q.reshape(b, n, self.n_actions)
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        obs = batch["obs"]            # (B, n, do)
+        nobs = batch["next_obs"]
+        acts = batch["actions"].astype(jnp.int32)  # (B, n)
+        rews = batch["rewards"]       # (B,) team
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        b = obs.shape[0]
+        state = obs.reshape(b, -1)
+        next_state = nobs.reshape(b, -1)
+
+        # target: per-agent best next Q (double-Q uses online argmax)
+        tq_next = self._q_impl(target_params["agent"], nobs)
+        if cfg.get("double_q", True):
+            oq_next = self._q_impl(params["agent"], nobs)
+            best = jnp.argmax(oq_next, axis=-1)
+        else:
+            best = jnp.argmax(tq_next, axis=-1)
+        q_next = jnp.take_along_axis(tq_next, best[..., None],
+                                     axis=-1)[..., 0]  # (B, n)
+        qtot_next = self.mixer.apply({"params": target_params["mixer"]},
+                                     q_next, next_state)
+        y = jax.lax.stop_gradient(
+            rews + gamma * not_done * qtot_next)
+
+        def loss_fn(p):
+            q = self._q_impl(p["agent"], obs)
+            q_sel = jnp.take_along_axis(q, acts[..., None],
+                                        axis=-1)[..., 0]  # (B, n)
+            qtot = self.mixer.apply({"params": p["mixer"]}, q_sel, state)
+            td = qtot - y
+            return jnp.mean(td ** 2), {
+                "mean_qtot": jnp.mean(qtot),
+                "mean_td_error": jnp.mean(jnp.abs(td))}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        stats = dict(stats)
+        stats["loss"] = loss_val
+        return params, opt_state, stats
+
+    # ---- acting / collection ----
+
+    def _joint_actions(self, obs_dict, epsilon: float):
+        obs = np.stack([obs_dict[a] for a in self.agent_ids])[None]
+        q = np.asarray(self._jit_q(self.params["agent"],
+                                   jnp.asarray(obs)))[0]  # (n, A)
+        greedy = np.argmax(q, axis=-1)
+        rand = self._np_rng.integers(self.n_actions, size=self.n_agents)
+        pick = self._np_rng.random(self.n_agents) < epsilon
+        acts = np.where(pick, rand, greedy)
+        return {a: int(acts[i]) for i, a in enumerate(self.agent_ids)}
+
+    def _collect(self, num_steps: int, epsilon: float) -> int:
+        rows: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "rewards", "dones",
+                                  "next_obs")}
+        for _ in range(num_steps):
+            acts = self._joint_actions(self._obs, epsilon)
+            nobs, rews, terms, truncs, _ = self.env.step(acts)
+            terminal = bool(terms.get("__all__"))
+            done = terminal or bool(truncs.get("__all__"))
+            team_r = float(np.mean([rews[a] for a in self.agent_ids]))
+            rows["obs"].append(
+                np.stack([self._obs[a] for a in self.agent_ids]))
+            rows["actions"].append(
+                np.array([acts[a] for a in self.agent_ids], np.int64))
+            rows["rewards"].append(np.float32(team_r))
+            # TD bootstraps THROUGH time-limit truncation; only true
+            # termination zeroes the target
+            rows["dones"].append(terminal)
+            # on terminal, next obs may be missing for done agents:
+            # fall back to the last obs (masked out by dones in the TD)
+            rows["next_obs"].append(np.stack(
+                [nobs.get(a, self._obs[a]) for a in self.agent_ids]))
+            self._episode_reward += team_r
+            if done:
+                self._episode_reward_window.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nobs
+        self.replay.add(SampleBatch(
+            {k: np.stack(v) if np.asarray(v[0]).ndim
+             else np.asarray(v) for k, v in rows.items()}))
+        return num_steps
+
+    # ---- Trainable / Algorithm surface ----
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        n = self._collect(cfg["rollout_fragment_length"], eps)
+        self._timesteps_total += n
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(max(1, cfg.get("training_intensity", 1))):
+                train = self.replay.sample(cfg["train_batch_size"])
+                jbatch = {k: jnp.asarray(v) for k, v in train.items()
+                          if isinstance(v, np.ndarray)
+                          and v.dtype != object}
+                self.params, self.opt_state, jstats = self._jit_update(
+                    self.params, self.target_params, self.opt_state,
+                    jbatch)
+                stats = {k: float(v) for k, v in jstats.items()}
+            self._maybe_sync_target(n)
+        return {
+            "num_env_steps_sampled_this_iter": n,
+            "epsilon": eps,
+            "replay_size": len(self.replay),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=10_000 + ep)
+            total, done = 0.0, False
+            while not done:
+                acts = self._joint_actions(obs, epsilon=0.0)
+                obs, rews, terms, truncs, _ = self.env.step(acts)
+                total += float(np.mean(list(rews.values())))
+                done = bool(terms.get("__all__")
+                            or truncs.get("__all__"))
+            rewards.append(total)
+        # restore the training env stream
+        self._obs, _ = self.env.reset()
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
+
+    def compute_joint_actions(self, obs_dict):
+        """Greedy joint action for deployment."""
+        return self._joint_actions(obs_dict, epsilon=0.0)
+

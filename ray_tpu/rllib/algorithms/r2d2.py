@@ -1,0 +1,319 @@
+"""R2D2 — recurrent replay distributed DQN (Kapturowski et al. 2019).
+
+Reference analogue: rllib/algorithms/r2d2/ (r2d2.py,
+r2d2_torch_policy.py): an LSTM Q-network trained on replayed
+SEQUENCES with burn-in — the first ``burn_in`` steps of each sampled
+sequence only warm up the recurrent state (no gradient), the remainder
+takes double-Q TD loss against a target network. This implementation
+uses the paper's zero-start-state + burn-in strategy and stores whole
+episodes in a sequence replay buffer.
+
+TPU-first: the LSTM unroll is a ``flax.linen.scan`` over time inside
+ONE jitted update — fixed (B, T) shapes, no per-step Python. Acting
+threads the recurrent state explicitly (functional carry, no hidden
+module state), so the collector is an ordinary host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.env import Discrete, make_env
+
+
+class _RecurrentQNet(nn.Module):
+    """Dense → LSTM → Q-head; call modes: ``step`` (one env step with
+    carry) and ``unroll`` (scan over a (B, T) sequence)."""
+
+    num_actions: int
+    hidden: int = 64
+    lstm_size: int = 64
+
+    def setup(self):
+        self.enc = nn.Dense(self.hidden)
+        self.cell = nn.OptimizedLSTMCell(self.lstm_size)
+        self.head = nn.Dense(self.num_actions)
+
+    def step(self, carry, obs):
+        x = nn.relu(self.enc(obs))
+        carry, y = self.cell(carry, x)
+        return carry, self.head(y)
+
+    def unroll(self, carry, obs_seq):
+        """obs_seq (B, T, do) → (carry, Q (B, T, A))."""
+        x = nn.relu(self.enc(obs_seq))
+
+        def body(cell, c, xt):
+            return cell(c, xt)
+
+        scan = nn.transforms.scan(
+            body, variable_broadcast="params", split_rngs={"params": False},
+            in_axes=1, out_axes=1)
+        carry, y = scan(self.cell, carry, x)
+        return carry, self.head(y)
+
+    def __call__(self, obs_seq):  # init-time wiring
+        carry = zero_carry(obs_seq.shape[0], self.lstm_size)
+        return self.unroll(carry, obs_seq)
+
+
+def zero_carry(batch: int, lstm_size: int):
+    """LSTM (c, h) zero state — the paper's zero-start-state strategy;
+    burn-in warms it up before the loss applies."""
+    return (jnp.zeros((batch, lstm_size)), jnp.zeros((batch, lstm_size)))
+
+
+class _SequenceReplay:
+    """Episode store sampling fixed-length subsequences with a
+    validity mask (short episodes are zero-padded)."""
+
+    def __init__(self, capacity_episodes: int, seq_len: int, seed=None):
+        self.capacity = capacity_episodes
+        self.seq_len = seq_len
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        self._rng = np.random.default_rng(seed)
+        self.num_steps = 0
+
+    def add_episode(self, ep: Dict[str, np.ndarray]):
+        self._episodes.append(ep)
+        self.num_steps += len(ep["rewards"])
+        while len(self._episodes) > self.capacity:
+            old = self._episodes.pop(0)
+            self.num_steps -= len(old["rewards"])
+
+    def sample(self, batch: int) -> Dict[str, np.ndarray]:
+        T = self.seq_len
+        out: Dict[str, list] = {k: [] for k in
+                                ("obs", "actions", "rewards", "dones",
+                                 "next_obs", "mask")}
+        for _ in range(batch):
+            ep = self._episodes[self._rng.integers(len(self._episodes))]
+            n = len(ep["rewards"])
+            start = int(self._rng.integers(0, max(1, n - T + 1)))
+            end = min(start + T, n)
+            pad = T - (end - start)
+
+            def cut(key, feat_shape):
+                seq = ep[key][start:end]
+                if pad:
+                    seq = np.concatenate(
+                        [seq, np.zeros((pad, *feat_shape),
+                                       seq.dtype)], axis=0)
+                return seq
+
+            do = ep["obs"].shape[1:]
+            out["obs"].append(cut("obs", do))
+            out["next_obs"].append(cut("next_obs", do))
+            out["actions"].append(cut("actions", ()))
+            out["rewards"].append(cut("rewards", ()))
+            out["dones"].append(cut("dones", ()))
+            m = np.zeros(T, np.float32)
+            m[:end - start] = 1.0
+            out["mask"].append(m)
+        return {k: np.stack(v) for k, v in out.items()}
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or R2D2)
+        self._config.update({
+            "lr": 5e-4,
+            "lstm_size": 64,
+            "agent_hidden": 64,
+            "double_q": True,
+            "seq_len": 20,
+            "burn_in": 4,
+            "replay_capacity_episodes": 500,
+            "learning_starts": 500,   # env steps
+            "train_batch_size": 32,   # sequences per update
+            "rollout_fragment_length": 64,
+            "target_network_update_freq": 300,
+            "initial_epsilon": 1.0,
+            "final_epsilon": 0.05,
+            "epsilon_timesteps": 5_000,
+            "training_intensity": 4,
+        })
+
+
+class R2D2(LocalAlgorithm):
+    _default_config_cls = R2D2Config
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        self.env = make_env(cfg["env"], cfg.get("env_config"))
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("R2D2 is discrete-action only")
+        self.n_actions = self.env.action_space.n
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+
+        self.qnet = _RecurrentQNet(self.n_actions, cfg["agent_hidden"],
+                                   cfg["lstm_size"])
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        dummy = jnp.zeros((1, cfg["seq_len"], self.obs_dim))
+        self.params = self.qnet.init(self._next_rng(), dummy)["params"]
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(10.0), optax.adam(cfg["lr"]))
+        self.opt_state = self.optimizer.init(self.params)
+        self._jit_step = jax.jit(self._step_impl)
+        self._jit_update = jax.jit(self._update_impl)
+
+        self.replay = _SequenceReplay(cfg["replay_capacity_episodes"],
+                                      cfg["seq_len"], cfg.get("seed"))
+        self._init_local_state()
+        self._reset_episode(seed=cfg.get("seed"))
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _reset_episode(self, seed=None):
+        self._obs, _ = self.env.reset(seed=seed)
+        self._carry = zero_carry(1, self.config["lstm_size"])
+        self._ep_rows: Dict[str, list] = {k: [] for k in
+                                          ("obs", "actions", "rewards",
+                                           "dones", "next_obs")}
+        self._ep_reward = 0.0
+
+    # ---- jitted programs ----
+
+    def _step_impl(self, params, carry, obs):
+        return self.qnet.apply({"params": params}, carry, obs,
+                               method=_RecurrentQNet.step)
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        burn = cfg["burn_in"]
+        obs = batch["obs"]          # (B, T, do)
+        nobs = batch["next_obs"]
+        acts = batch["actions"].astype(jnp.int32)
+        rews = batch["rewards"]
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        mask = batch["mask"]
+        # gradient (and TD) only after the burn-in prefix
+        mask = mask.at[:, :burn].set(0.0)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        b = obs.shape[0]
+        zero = zero_carry(b, cfg["lstm_size"])
+
+        def q_unroll(p, seq):
+            _, q = self.qnet.apply({"params": p}, zero, seq,
+                                   method=_RecurrentQNet.unroll)
+            return q  # (B, T, A)
+
+        tq_next = q_unroll(target_params, nobs)
+        if cfg.get("double_q", True):
+            best = jnp.argmax(q_unroll(params, nobs), axis=-1)
+        else:
+            best = jnp.argmax(tq_next, axis=-1)
+        q_next = jnp.take_along_axis(tq_next, best[..., None],
+                                     axis=-1)[..., 0]
+        y = jax.lax.stop_gradient(rews + gamma * not_done * q_next)
+
+        def loss_fn(p):
+            q = q_unroll(p, obs)
+            q_sel = jnp.take_along_axis(q, acts[..., None],
+                                        axis=-1)[..., 0]
+            td = (q_sel - y) * mask
+            loss = jnp.sum(td ** 2) / denom
+            return loss, {"mean_q": jnp.sum(q_sel * mask) / denom,
+                          "mean_td_error":
+                              jnp.sum(jnp.abs(td)) / denom}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        stats = dict(stats)
+        stats["loss"] = loss_val
+        return params, opt_state, stats
+
+    # ---- acting ----
+
+    def _act(self, obs, epsilon: float) -> int:
+        self._carry, q = self._jit_step(
+            self.params, self._carry,
+            jnp.asarray(obs, jnp.float32)[None])
+        if self._np_rng.random() < epsilon:
+            return int(self._np_rng.integers(self.n_actions))
+        return int(np.argmax(np.asarray(q)[0]))
+
+    def _collect(self, num_steps: int, epsilon: float) -> int:
+        for _ in range(num_steps):
+            a = self._act(self._obs, epsilon)
+            nobs, r, term, trunc, _ = self.env.step(a)
+            rows = self._ep_rows
+            rows["obs"].append(np.asarray(self._obs, np.float32))
+            rows["actions"].append(np.int64(a))
+            rows["rewards"].append(np.float32(r))
+            rows["dones"].append(bool(term))
+            rows["next_obs"].append(np.asarray(nobs, np.float32))
+            self._ep_reward += float(r)
+            if term or trunc:
+                self.replay.add_episode(
+                    {k: np.stack(v) if np.asarray(v[0]).ndim
+                     else np.asarray(v) for k, v in rows.items()})
+                self._episode_reward_window.append(self._ep_reward)
+                self._reset_episode()
+            else:
+                self._obs = nobs
+        return num_steps
+
+    # ---- Trainable / Algorithm surface ----
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        n = self._collect(cfg["rollout_fragment_length"], eps)
+        self._timesteps_total += n
+        stats: Dict[str, float] = {}
+        if self.replay.num_steps >= cfg["learning_starts"]:
+            for _ in range(max(1, cfg.get("training_intensity", 1))):
+                train = self.replay.sample(cfg["train_batch_size"])
+                jbatch = {k: jnp.asarray(v) for k, v in train.items()}
+                self.params, self.opt_state, jstats = self._jit_update(
+                    self.params, self.target_params, self.opt_state,
+                    jbatch)
+                stats = {k: float(v) for k, v in jstats.items()}
+            self._maybe_sync_target(n)
+        return {
+            "num_env_steps_sampled_this_iter": n,
+            "epsilon": eps,
+            "replay_episodes": len(self.replay._episodes),
+            "replay_steps": self.replay.num_steps,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=10_000 + ep)
+            carry = zero_carry(1, self.config["lstm_size"])
+            total, done = 0.0, False
+            while not done:
+                carry, q = self._jit_step(
+                    self.params, carry,
+                    jnp.asarray(obs, jnp.float32)[None])
+                obs, r, term, trunc, _ = self.env.step(
+                    int(np.argmax(np.asarray(q)[0])))
+                total += float(r)
+                done = term or trunc
+            rewards.append(total)
+        self._reset_episode()
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
+

@@ -1,0 +1,109 @@
+"""Fifth-wave RLlib algorithms: QMIX (cooperative multi-agent value
+decomposition) and R2D2 (recurrent replay DQN).
+
+Reference analogues: rllib/algorithms/qmix/tests/,
+rllib/algorithms/r2d2/tests/test_r2d2.py.
+"""
+
+import numpy as np
+
+
+def test_qmix_machinery_and_checkpoint():
+    from ray_tpu.rllib.algorithms.qmix import QMixConfig
+    algo = (QMixConfig().environment("CoopCartPole",
+                                     env_config={"num_agents": 2})
+            .training(learning_starts=200, rollout_fragment_length=64,
+                      train_batch_size=32)
+            .debugging(seed=0).build())
+    for _ in range(6):
+        r = algo.step()
+    assert r["replay_size"] >= 300
+    assert "learner/mean_qtot" in r
+    assert np.isfinite(r["learner/loss"])
+    st = algo.save_checkpoint()
+    algo.load_checkpoint(st)
+    acts = algo.compute_joint_actions(
+        {a: np.zeros(4, np.float32) for a in algo.agent_ids})
+    assert set(acts) == set(algo.agent_ids)
+    algo.cleanup()
+
+
+def test_qmix_mixer_is_monotonic():
+    """∂Q_tot/∂Q_i ≥ 0 for every agent — the defining QMIX constraint."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.algorithms.qmix import _QMixer
+    mixer = _QMixer(n_agents=3, embed=16)
+    rng = jax.random.PRNGKey(0)
+    params = mixer.init(rng, jnp.zeros((1, 3)), jnp.zeros((1, 12)))[
+        "params"]
+    state = jax.random.normal(jax.random.PRNGKey(1), (8, 12))
+    qs = jax.random.normal(jax.random.PRNGKey(2), (8, 3))
+    grads = jax.vmap(
+        jax.grad(lambda q, s: mixer.apply(
+            {"params": params}, q[None], s[None])[0]))(qs, state)
+    assert (np.asarray(grads) >= -1e-6).all(), grads.min()
+
+
+def test_qmix_learns_coop_cartpole():
+    """Team reward (episode ends when ANY pole falls) climbs well above
+    the random baseline (~13)."""
+    from ray_tpu.rllib.algorithms.qmix import QMixConfig
+    algo = (QMixConfig().environment("CoopCartPole",
+                                     env_config={"num_agents": 2})
+            .training(learning_starts=300, rollout_fragment_length=64,
+                      train_batch_size=64, epsilon_timesteps=4000,
+                      training_intensity=4, lr=1e-3)
+            .debugging(seed=0).build())
+    best = 0.0
+    for i in range(170):
+        algo.step()
+        if (i + 1) % 15 == 0:
+            ev = algo.evaluate(num_episodes=3)["evaluation"]
+            best = max(best, ev["episode_reward_mean"])
+            if best > 40:
+                break
+    algo.cleanup()
+    assert best > 30, f"QMIX stuck at {best}"
+
+
+def test_r2d2_sequence_replay_padding():
+    from ray_tpu.rllib.algorithms.r2d2 import _SequenceReplay
+    rep = _SequenceReplay(capacity_episodes=10, seq_len=8, seed=0)
+    rep.add_episode({
+        "obs": np.ones((3, 4), np.float32),
+        "next_obs": np.ones((3, 4), np.float32),
+        "actions": np.zeros(3, np.int64),
+        "rewards": np.ones(3, np.float32),
+        "dones": np.array([False, False, True]),
+    })
+    out = rep.sample(4)
+    assert out["obs"].shape == (4, 8, 4)
+    assert out["mask"].shape == (4, 8)
+    # 3-step episode inside an 8-step window: exactly 3 valid rows
+    assert (out["mask"].sum(axis=1) == 3).all()
+    # padded rows are zeroed
+    assert (out["rewards"] * (1 - out["mask"]) == 0).all()
+
+
+def test_r2d2_learns_cartpole():
+    from ray_tpu.rllib.algorithms.r2d2 import R2D2Config
+    algo = (R2D2Config().environment("CartPole-v1")
+            .training(learning_starts=300, rollout_fragment_length=64,
+                      train_batch_size=32, epsilon_timesteps=3000,
+                      training_intensity=8, lr=1e-3, seq_len=10,
+                      burn_in=2, target_network_update_freq=200)
+            .debugging(seed=0).build())
+    best = 0.0
+    for i in range(100):
+        algo.step()
+        if (i + 1) % 20 == 0:
+            ev = algo.evaluate(num_episodes=3)["evaluation"]
+            best = max(best, ev["episode_reward_mean"])
+            if best > 70:
+                break
+    # checkpoint roundtrip keeps recurrent-net params
+    st = algo.save_checkpoint()
+    algo.load_checkpoint(st)
+    algo.cleanup()
+    assert best > 60, f"R2D2 stuck at {best}"
