@@ -66,18 +66,89 @@ def _read_file(path: str) -> bytes:
         return f.read()
 
 
+def _chips_from_accel_type(accel: str) -> Optional[int]:
+    """Per-host chip count from an accelerator type like
+    'v5litepod-16' / 'v4-32': total chips divided by slice host count
+    (v4 counts cores, 2/chip)."""
+    try:
+        gen, _, total_s = accel.partition("-")
+        total = int(total_s)
+        if gen in ("v2", "v3", "v4", "v5p"):
+            total //= 2  # "-N" counts cores on these gens
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        hosts = max(1, len([h for h in hostnames.split(",") if h]))
+        per_host = max(1, total // hosts)
+        # physical per-host ceiling guards the common misconfig of a
+        # multi-host slice without TPU_WORKER_HOSTNAMES set: no host
+        # has more than 8 chips (v5e) / 4 chips (other gens)
+        return min(per_host, 8 if gen.startswith("v5lite") else 4)
+    except (ValueError, ZeroDivisionError):
+        return None
+
+
+_MDS_CACHE: List[Optional[int]] = []
+
+
+def _chips_from_metadata_server(timeout: float = 0.5) -> Optional[int]:
+    """GCE TPU-VM metadata query (reference analogue: the
+    resource_spec.py accelerator autodetection). Gated by
+    TPU_SKIP_MDS_QUERY for zero-egress/tunneled environments; any
+    failure is treated as 'not on a TPU VM' and cached process-wide so
+    repeated raylet starts don't re-pay DNS timeouts."""
+    if os.environ.get("TPU_SKIP_MDS_QUERY"):
+        return None
+    if _MDS_CACHE:
+        return _MDS_CACHE[0]
+    _MDS_CACHE.append(None)
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/"
+            "instance/attributes/accelerator-type",
+            headers={"Metadata-Flavor": "Google"})
+        accel = urllib.request.urlopen(
+            req, timeout=timeout).read().decode().strip()
+        _MDS_CACHE[0] = _chips_from_accel_type(accel) if accel else None
+    except Exception:
+        pass
+    return _MDS_CACHE[0]
+
+
 def detect_tpu_chips(config: SystemConfig) -> int:
+    """Chips this raylet may schedule. Order: explicit config >
+    RTPU_NUM_TPUS > granted-chip env (TPU_VISIBLE_CHIPS — what a parent
+    raylet/test granted us, the TPU analogue of CUDA_VISIBLE_DEVICES) >
+    physical device files > GCE metadata > accelerator-type env >
+    JAX-platform hint."""
     if config.tpu_chips_per_host >= 0:
         return config.tpu_chips_per_host
     env = os.environ.get("RTPU_NUM_TPUS")
     if env is not None:
         return int(env)
+    granted = os.environ.get("TPU_VISIBLE_CHIPS")
+    if granted is None:  # "" is a valid grant: zero chips
+        granted = os.environ.get("TPU_VISIBLE_DEVICES")
+    if granted is not None:
+        return len([c for c in granted.split(",") if c.strip() != ""])
     # physical device files on real TPU VMs
     n = len([d for d in os.listdir("/dev")
              if d.startswith("accel") or d.startswith("vfio")]
             ) if os.path.isdir("/dev") else 0
     if n:
-        return n
+        # cross-check against the declared topology when present: the
+        # granted slice may be smaller than the host's device files
+        accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+        declared = _chips_from_accel_type(accel) if accel else None
+        return min(n, declared) if declared else n
+    # the free env check comes BEFORE the (network) metadata query
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if accel:
+        declared = _chips_from_accel_type(accel)
+        if declared:
+            return declared
+    mds = _chips_from_metadata_server()
+    if mds:
+        return mds
     # tunneled single-chip environments (axon) expose the chip via the JAX
     # platform plugin only
     if os.environ.get("JAX_PLATFORMS", "") in ("axon", "tpu"):

@@ -25,34 +25,19 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
-from ray_tpu.rllib.env import (CartPoleEnv, Discrete, MultiAgentEnv,
-                               _BUILTIN_ENVS, make_env)
+from ray_tpu.rllib.env import (Discrete, MultiAgentCartPole,
+                               MultiAgentEnv, _BUILTIN_ENVS, make_env)
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
-class CooperativeCartPole(MultiAgentEnv):
+class CooperativeCartPole(MultiAgentCartPole):
     """Team CartPole: episode ends when ANY pole falls; every agent
     receives the TEAM reward (mean of alive rewards) — a minimal fully
     cooperative env for value-decomposition tests (reference analogue:
-    the grouped TwoStepGame in rllib/examples/env/two_step_game.py)."""
-
-    def __init__(self, config: Optional[Dict[str, Any]] = None):
-        config = config or {}
-        self.num_agents = int(config.get("num_agents", 2))
-        self.agent_ids = [f"agent_{i}" for i in range(self.num_agents)]
-        self._envs = {aid: CartPoleEnv() for aid in self.agent_ids}
-        e = next(iter(self._envs.values()))
-        self.observation_space = e.observation_space
-        self.action_space = e.action_space
-
-    def reset(self, *, seed: Optional[int] = None):
-        obs, infos = {}, {}
-        for i, (aid, e) in enumerate(self._envs.items()):
-            o, info = e.reset(
-                seed=None if seed is None else seed + i)
-            obs[aid], infos[aid] = o, info
-        return obs, infos
+    the grouped TwoStepGame in rllib/examples/env/two_step_game.py).
+    Construction/reset come from MultiAgentCartPole; only the
+    cooperative step() differs."""
 
     def step(self, action_dict: Dict[Any, Any]):
         obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
@@ -317,8 +302,10 @@ class QMix(LocalAlgorithm):
                 done = bool(terms.get("__all__")
                             or truncs.get("__all__"))
             rewards.append(total)
-        # restore the training env stream
+        # restore the training env stream; the interrupted episode's
+        # partial reward must not leak into the next episode's metric
         self._obs, _ = self.env.reset()
+        self._episode_reward = 0.0
         return {"evaluation": {
             "episode_reward_mean": float(np.mean(rewards)),
             "episode_reward_min": float(np.min(rewards)),

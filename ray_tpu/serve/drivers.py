@@ -20,9 +20,12 @@ class _DAGDriverImpl:
     constructs (serve.run converts bound deployments inside dict args).
     """
 
-    def __init__(self, routes: Dict[str, Any]):
+    def __init__(self, routes: Dict[str, Any], http_adapter=None):
         self.routes = {("/" + k.strip("/")) if k != "/" else "/": v
                        for k, v in routes.items()}
+        # payload transform applied before dispatch (reference:
+        # DAGDriver's http_adapter; see serve/http_adapters.py)
+        self.http_adapter = http_adapter
 
     def _match(self, path: str) -> Optional[str]:
         path = "/" + path.strip("/") if path != "/" else "/"
@@ -38,6 +41,8 @@ class _DAGDriverImpl:
         prefix = self._match(__serve_path__)
         if prefix is None:
             raise KeyError(f"no DAG route matches {__serve_path__!r}")
+        if self.http_adapter is not None and payload is not None:
+            payload = self.http_adapter(payload)
         handle = self.routes[prefix]
         ref = (handle.remote(payload) if payload is not None
                else handle.remote())

@@ -14,7 +14,8 @@ from ray_tpu.tune.search import (  # noqa: F401
     HyperOptSearch, OptunaSearch, RandomSearch, Searcher, TPESearcher)
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    MedianStoppingRule, PB2, PopulationBasedTraining, TrialScheduler)
+    HyperBandScheduler, MedianStoppingRule, PB2,
+    PopulationBasedTraining, ResourceChangingScheduler, TrialScheduler)
 from ray_tpu.tune.logger import (  # noqa: F401
     Callback, CSVLoggerCallback, JsonLoggerCallback, LoggerCallback,
     TBXLoggerCallback)

@@ -100,6 +100,94 @@ class AsyncHyperBandScheduler(TrialScheduler):
 ASHAScheduler = AsyncHyperBandScheduler
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand (reference: schedulers/hyperband.py).
+
+    Incoming trials round-robin into brackets s = s_max..0; bracket s
+    starts its trials with grace period eta^s — bracket 0 culls most
+    aggressively (grace 1), bracket s_max (grace ≈ max_t) runs its
+    trials essentially to full budget, preserving HyperBand's
+    no-one-regime-wins-everywhere guarantee. Successive-halving rungs
+    cull to the top 1/eta within the bracket. Decisions are made
+    asynchronously per result (no global pause barrier — the
+    ASHA-style relaxation of the synchronous algorithm, which composes
+    with this runner's streaming result loop)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = TRAINING_ITERATION,
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # integer power loop: float log truncates (log(1000,10)=2.999…)
+        # and would drop the full-budget bracket
+        s_max, r = 0, 1
+        while r * reduction_factor <= max_t:
+            r *= reduction_factor
+            s_max += 1
+        # one ASHA ladder per bracket, with bracket-specific grace
+        self._brackets = [
+            AsyncHyperBandScheduler(
+                metric=metric, mode=mode, time_attr=time_attr,
+                max_t=max_t,
+                grace_period=max(1, int(reduction_factor ** s)),
+                reduction_factor=reduction_factor)
+            for s in range(s_max, -1, -1)]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        for b in self._brackets:
+            b.set_search_properties(metric, mode)
+
+    def _bracket_for(self, trial) -> "AsyncHyperBandScheduler":
+        idx = self._assignment.get(trial.trial_id)
+        if idx is None:
+            idx = self._next % len(self._brackets)
+            self._assignment[trial.trial_id] = idx
+            self._next += 1
+        return self._brackets[idx]
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        return self._bracket_for(trial).on_trial_result(
+            runner, trial, result)
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Wraps a base scheduler and reallocates per-trial resources while
+    trials run (reference: schedulers/resource_changing_scheduler.py).
+
+    ``resources_allocation_function(runner, trial, result, scheduler)``
+    returns a resources dict (or None = keep); a change restarts the
+    trial's actor from its latest checkpoint with the new allocation
+    via runner.update_trial_resources."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc_fn = resources_allocation_function
+        self.metric = getattr(self.base, "metric", None)
+        self.mode = getattr(self.base, "mode", "max")
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        self.base.set_search_properties(metric, mode)
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        decision = self.base.on_trial_result(runner, trial, result)
+        if decision == STOP or self.alloc_fn is None:
+            return decision
+        new = self.alloc_fn(runner, trial, result, self)
+        if new:
+            runner.update_trial_resources(trial, new)
+        return decision
+
+    def on_trial_complete(self, runner, trial, result):
+        self.base.on_trial_complete(runner, trial, result)
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose running-average is below the median of the other
     trials' running averages at the same point (reference:

@@ -10,11 +10,14 @@ results stream back as object refs, schedulers/searchers see every result.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Type, Union
+
+logger = logging.getLogger(__name__)
 
 import ray_tpu
 from ray_tpu.tune import search as search_mod
@@ -357,6 +360,32 @@ class TrialRunner:
             trial.actor = None
             self._start_trial(trial, checkpoint=ckpt)
         trial.ckpt_manager.add(ckpt, donor.last_result or {})
+
+    # ResourceChangingScheduler hook (called by the scheduler)
+    def update_trial_resources(self, trial: Trial,
+                               resources: Dict[str, float]):
+        """Restart the trial's actor with new resources from its own
+        latest checkpoint (reference: ray_trial_executor's
+        resource-update path used by ResourceChangingScheduler)."""
+        if dict(trial.resources) == dict(resources):
+            return False
+        ckpt = trial.latest_checkpoint
+        if ckpt is None:
+            # restarting without a checkpoint would discard all progress
+            logger.warning(
+                "skipping resource update for %s: no checkpoint yet "
+                "(set checkpoint_freq>=1 to let resources change)",
+                trial.trial_id)
+            return False
+        try:
+            if trial.actor is not None:
+                ray_tpu.kill(trial.actor)
+        except Exception:
+            pass
+        trial.actor = None
+        trial.resources = dict(resources)
+        self._start_trial(trial, checkpoint=ckpt)
+        return True
 
     # ---------------------------------------------------------------- loop
 

@@ -160,3 +160,35 @@ def test_builder_function_import_path():
         args={"prefix": "yo"})
     app = build_app(schema)
     assert app.root.deployment.name == "ConfigEcho"
+
+
+def test_http_adapters_unit():
+    import numpy as np
+    from ray_tpu.serve import http_adapters as ha
+    a = ha.json_to_ndarray({"array": [[1, 2], [3, 4]]})
+    assert a.shape == (2, 2) and a.dtype == np.float32
+    assert ha.json_to_ndarray([1.0, 2.0]).tolist() == [1.0, 2.0]
+    with pytest.raises(ValueError):
+        ha.json_to_ndarray({"wrong": 1})
+    multi = ha.json_to_multi_ndarray({"x": [1], "y": [2, 3]})
+    assert set(multi) == {"x", "y"} and multi["y"].shape == (2,)
+    assert ha.starlette_request({"a": 1}) == {"a": 1}
+    df = ha.pandas_read_json([{"a": 1, "b": 2}, {"a": 3, "b": 4}])
+    assert list(df.columns) == ["a", "b"] and len(df) == 2
+
+
+def test_dag_driver_with_http_adapter(serve_cluster):
+    from ray_tpu.serve.drivers import DAGDriver
+    from ray_tpu.serve.http_adapters import json_to_ndarray
+
+    @serve.deployment
+    class SumModel:
+        def __call__(self, arr):
+            return {"sum": float(arr.sum())}
+
+    app = DAGDriver.options(name="AdapterDriver").bind(
+        {"/sum": SumModel.bind()}, http_adapter=json_to_ndarray)
+    serve.run(app, http_port=8127)
+    proxy = ray_tpu.get_actor("SERVE_PROXY")
+    port = ray_tpu.get(proxy.get_port.remote())
+    assert _get(port, "/sum", {"array": [1, 2, 3.5]}) == {"sum": 6.5}

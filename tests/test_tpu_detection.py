@@ -1,0 +1,70 @@
+"""TPU chip/topology detection (raylet.py detect_tpu_chips).
+
+Reference analogue: _private/resource_spec.py accelerator
+autodetection tests.
+"""
+
+import os
+from unittest import mock
+
+from ray_tpu._private.raylet import (_chips_from_accel_type,
+                                     detect_tpu_chips)
+from ray_tpu.common.config import SystemConfig
+
+
+def _cfg(chips=-1):
+    c = SystemConfig()
+    c.tpu_chips_per_host = chips
+    return c
+
+
+def test_explicit_config_wins():
+    with mock.patch.dict(os.environ, {"RTPU_NUM_TPUS": "7"}):
+        assert detect_tpu_chips(_cfg(chips=2)) == 2
+
+
+def test_env_override():
+    with mock.patch.dict(os.environ, {"RTPU_NUM_TPUS": "3"}):
+        assert detect_tpu_chips(_cfg()) == 3
+
+
+def test_granted_chips_env():
+    env = {"TPU_VISIBLE_CHIPS": "0,1,2", "RTPU_NUM_TPUS": ""}
+    env.pop("RTPU_NUM_TPUS")
+    with mock.patch.dict(os.environ, env, clear=False):
+        os.environ.pop("RTPU_NUM_TPUS", None)
+        assert detect_tpu_chips(_cfg()) == 3
+    # empty grant = zero chips (a worker fenced off from the TPU)
+    with mock.patch.dict(os.environ, {"TPU_VISIBLE_CHIPS": ""}):
+        os.environ.pop("RTPU_NUM_TPUS", None)
+        assert detect_tpu_chips(_cfg()) == 0
+
+
+def test_accel_type_parsing():
+    # v5e counts chips directly
+    assert _chips_from_accel_type("v5litepod-8") == 8
+    # v4 counts cores (2 per chip); without TPU_WORKER_HOSTNAMES the
+    # per-host physical ceiling (4 chips on v4) caps the guess so a
+    # multi-host slice can't be mistaken for one 16-chip host
+    assert _chips_from_accel_type("v4-32") == 4
+    with mock.patch.dict(os.environ, {
+            "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3"}):
+        assert _chips_from_accel_type("v4-32") == 4
+    assert _chips_from_accel_type("bogus") is None
+
+
+def test_accel_type_divided_across_hosts():
+    with mock.patch.dict(os.environ, {
+            "TPU_WORKER_HOSTNAMES": "host-0,host-1"}):
+        assert _chips_from_accel_type("v5litepod-16") == 8
+
+
+def test_accel_type_env_fallback():
+    env = {"TPU_ACCELERATOR_TYPE": "v5litepod-4",
+           "TPU_SKIP_MDS_QUERY": "1"}
+    with mock.patch.dict(os.environ, env):
+        for k in ("RTPU_NUM_TPUS", "TPU_VISIBLE_CHIPS",
+                  "TPU_VISIBLE_DEVICES"):
+            os.environ.pop(k, None)
+        with mock.patch("os.path.isdir", return_value=False):
+            assert detect_tpu_chips(_cfg()) == 4

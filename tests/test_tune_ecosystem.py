@@ -248,3 +248,54 @@ def test_pb2_smoke(cluster):
     assert len(analysis.trials) == 4
     assert all(t.status == "TERMINATED" for t in analysis.trials)
     assert analysis.best_result["score"] > 0
+
+
+# ------------------------------------------- HyperBand / resource changing
+
+
+def test_hyperband_brackets_cull(cluster):
+    """Weak trials stop early; at least one strong trial reaches max_t."""
+    def train_fn(config):
+        for i in range(27):
+            session.report({"score": config["q"] * (i + 1),
+                            "training_iteration": i + 1})
+
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=27,
+                               reduction_factor=3)
+    analysis = tune.run(
+        train_fn, config={"q": s.grid_search([0.1, 0.4, 0.7, 1.0])},
+        metric="score", mode="max", scheduler=sched,
+        max_concurrent_trials=4)
+    iters = {t.config["q"]: (t.last_result or {}).get(
+        "training_iteration", 0) for t in analysis.trials}
+    assert max(iters.values()) == 27          # a survivor went the distance
+    assert analysis.best_result["score"] >= 27 * 0.7
+
+
+def test_resource_changing_scheduler(cluster):
+    """The allocation hook reallocates CPU mid-run; the trial restarts
+    from checkpoint with the new resources and still finishes."""
+    seen = []
+
+    def train_fn(config):
+        import ray_tpu as rt
+        for i in range(6):
+            session.report({"score": i + 1,
+                            "training_iteration": i + 1})
+
+    def alloc(runner, trial, result, scheduler):
+        if result.get("training_iteration") == 2:
+            return {"CPU": 2.0}
+        return None
+
+    from ray_tpu.tune.schedulers import ResourceChangingScheduler
+    sched = ResourceChangingScheduler(
+        resources_allocation_function=alloc)
+    analysis = tune.run(
+        train_fn, config={}, num_samples=1, metric="score", mode="max",
+        scheduler=sched, checkpoint_freq=1)
+    t = analysis.trials[0]
+    assert t.status == "TERMINATED"
+    assert t.resources == {"CPU": 2.0}
+    assert (t.last_result or {}).get("score") == 6

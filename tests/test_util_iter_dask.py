@@ -1,0 +1,79 @@
+"""ParallelIterator (util/iter.py) and Dask-on-ray_tpu scheduler
+(util/dask.py).
+
+Reference analogues: python/ray/util/iter.py tests,
+python/ray/util/dask/scheduler.py (ray_dask_get).
+"""
+
+import operator
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                       object_store_memory=128 * 1024 * 1024)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_parallel_iterator_for_each_gather_sync(cluster):
+    from ray_tpu.util.iter import from_range
+    it = from_range(10, num_shards=3).for_each(lambda x: x * 2)
+    got = sorted(it.gather_sync())
+    assert got == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+    it.stop()
+
+
+def test_parallel_iterator_filter_batch_flatten(cluster):
+    from ray_tpu.util.iter import from_items
+    it = (from_items(list(range(20)), num_shards=2)
+          .filter(lambda x: x % 2 == 0)
+          .batch(3)
+          .flatten())
+    assert sorted(it.gather_sync()) == list(range(0, 20, 2))
+    it.stop()
+
+
+def test_parallel_iterator_gather_async_and_take(cluster):
+    from ray_tpu.util.iter import from_range
+    it = from_range(100, num_shards=4).for_each(lambda x: x + 1)
+    got = sorted(it.gather_async(fetch=8))
+    assert got == list(range(1, 101))
+    assert len(it.take(5)) == 5
+    assert it.count() == 100
+    it.stop()
+
+
+def test_ray_dask_get_executes_graph(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+    # diamond: d depends on b and c, both depend on a
+    dsk = {
+        "a": 10,
+        "b": (operator.add, "a", 1),
+        "c": (operator.mul, "a", 2),
+        "d": (operator.add, "b", "c"),
+    }
+    assert ray_dask_get(dsk, "d") == 31
+    assert ray_dask_get(dsk, ["b", "c"]) == [11, 20]
+    assert ray_dask_get(dsk, [["a", "d"]]) == [[10, 31]]
+
+
+def test_ray_dask_get_nested_tasks_and_lists(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+    dsk = {
+        "x": 4,
+        # nested task inside a task + list-of-keys argument
+        "y": (sum, [(operator.mul, "x", 2), "x", 1]),
+    }
+    assert ray_dask_get(dsk, "y") == 13
+
+
+def test_ray_dask_get_cycle_detection(cluster):
+    from ray_tpu.util.dask import ray_dask_get
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (operator.neg, "b"),
+                      "b": (operator.neg, "a")}, "a")
