@@ -18,6 +18,7 @@ from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
                                              BanditLinTSConfig,
                                              BanditLinUCB,
@@ -35,4 +36,4 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "ES", "ESConfig", "ARS", "ARSConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
            "BanditLinTS", "BanditLinTSConfig",
-           "QMix", "QMixConfig", "R2D2", "R2D2Config"]
+           "QMix", "QMixConfig", "R2D2", "R2D2Config", "DT", "DTConfig"]

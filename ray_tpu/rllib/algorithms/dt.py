@@ -1,0 +1,296 @@
+"""DT — Decision Transformer (Chen et al. 2021), offline RL as
+sequence modeling.
+
+Reference analogue: rllib/algorithms/dt/ (dt.py, dt_torch_model.py,
+segmentation_buffer.py): trajectories become token sequences
+[R̂_1, s_1, a_1, R̂_2, s_2, a_2, ...] (R̂ = return-to-go); a small
+causal transformer is trained to predict a_t from the prefix ending at
+s_t; acting conditions on a target return and feeds back observed
+rewards. Trained purely from a JsonReader dataset.
+
+TPU-first: the interleaved (B, 3K, D) token batch runs through jitted
+causal attention blocks — pure MXU matmuls with a static mask; the
+per-step eval context is a fixed-size rolling window so the acting
+forward is ONE compiled program too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import logging
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.env import Discrete, make_env
+from ray_tpu.rllib.offline import JsonReader, OfflineDataConfigMixin
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+logger = logging.getLogger(__name__)
+
+
+class _CausalBlock(nn.Module):
+    dim: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        h = nn.LayerNorm()(x)
+        h = nn.SelfAttention(num_heads=self.heads,
+                             qkv_features=self.dim,
+                             deterministic=True)(h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(4 * self.dim)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim)(h)
+        return x + h
+
+
+class _DTNet(nn.Module):
+    """Interleaved (rtg, state, action) token transformer; action
+    logits are read at the STATE token positions (reference:
+    dt_torch_model.py)."""
+
+    obs_dim: int
+    n_actions: int
+    context: int  # K timesteps -> 3K tokens
+    dim: int = 64
+    heads: int = 4
+    layers: int = 2
+    max_timestep: int = 1024
+
+    @nn.compact
+    def __call__(self, rtg, obs, acts, timesteps):
+        # rtg (B,K,1), obs (B,K,do), acts (B,K) int, timesteps (B,K) int
+        b, k = acts.shape
+        t_emb = nn.Embed(self.max_timestep, self.dim)(
+            jnp.clip(timesteps, 0, self.max_timestep - 1))
+        r_tok = nn.Dense(self.dim)(rtg) + t_emb
+        s_tok = nn.Dense(self.dim)(obs) + t_emb
+        a_tok = nn.Embed(self.n_actions + 1, self.dim)(
+            jnp.clip(acts + 1, 0, self.n_actions)) + t_emb
+        # interleave -> (B, 3K, D): [r_1, s_1, a_1, r_2, ...]
+        x = jnp.stack([r_tok, s_tok, a_tok],
+                      axis=2).reshape(b, 3 * k, self.dim)
+        causal = nn.make_causal_mask(jnp.ones((b, 3 * k)))
+        for _ in range(self.layers):
+            x = _CausalBlock(self.dim, self.heads)(x, causal)
+        x = nn.LayerNorm()(x)
+        s_positions = x.reshape(b, k, 3, self.dim)[:, :, 1]  # state toks
+        return nn.Dense(self.n_actions)(s_positions)  # (B, K, A)
+
+
+class DTConfig(OfflineDataConfigMixin, AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DT)
+        self._config.update({
+            "input_path": None,
+            "context_length": 8,
+            "embed_dim": 64,
+            "num_heads": 4,
+            "num_layers": 2,
+            "lr": 1e-3,
+            "train_batch_size": 64,
+            "num_iters_per_step": 20,
+            # acting: return prompt (None = best dataset return)
+            "target_return": None,
+        })
+
+
+class DT(LocalAlgorithm):
+    _default_config_cls = DTConfig
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        self.env = make_env(cfg["env"], cfg.get("env_config"))
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("this DT implementation is discrete-only")
+        self.n_actions = self.env.action_space.n
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.K = cfg["context_length"]
+
+        path = cfg.get("input_path")
+        if not path:
+            raise ValueError("DT needs config['input_path']")
+        self._episodes = self._segment(JsonReader(path).read_all())
+        best_ret = max(float(ep["rtg"][0]) for ep in self._episodes)
+        self.target_return = (cfg["target_return"]
+                              if cfg["target_return"] is not None
+                              else best_ret)
+
+        self.net = _DTNet(self.obs_dim, self.n_actions, self.K,
+                          cfg["embed_dim"], cfg["num_heads"],
+                          cfg["num_layers"])
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        dummy = (jnp.zeros((1, self.K, 1)),
+                 jnp.zeros((1, self.K, self.obs_dim)),
+                 jnp.zeros((1, self.K), jnp.int32),
+                 jnp.zeros((1, self.K), jnp.int32))
+        self.params = self.net.init(self._next_rng(), *dummy)["params"]
+        self.target_params = {}  # none: not a TD method
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(1.0), optax.adamw(cfg["lr"]))
+        self.opt_state = self.optimizer.init(self.params)
+        self._jit_update = jax.jit(self._update_impl)
+        self._jit_logits = jax.jit(
+            lambda p, r, o, a, t: self.net.apply({"params": p},
+                                                 r, o, a, t))
+        self._init_local_state()
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- data ----
+
+    def _segment(self, data: SampleBatch) -> List[Dict[str, np.ndarray]]:
+        """Split the flat batch into episodes with returns-to-go
+        (reference: dt/segmentation_buffer.py)."""
+        obs = np.asarray(data[SampleBatch.OBS], np.float32)
+        acts = np.asarray(data[SampleBatch.ACTIONS], np.int64).reshape(-1)
+        rews = np.asarray(data[SampleBatch.REWARDS], np.float32)
+        dones = np.asarray(data[SampleBatch.DONES], bool)
+        eps, start = [], 0
+        for t in range(len(rews)):
+            if dones[t]:
+                sl = slice(start, t + 1)
+                r = rews[sl]
+                rtg = np.cumsum(r[::-1])[::-1].astype(np.float32)
+                eps.append({"obs": obs[sl], "acts": acts[sl],
+                            "rtg": rtg,
+                            "t": np.arange(t + 1 - start, dtype=np.int64)})
+                start = t + 1
+        # a trailing fragment (recording stopped mid-episode) has an
+        # understated return-to-go — drop it rather than train on it
+        if start < len(rews):
+            logger.warning(
+                "DT: dropping %d-step trailing partial episode "
+                "(dataset ends without done=True)", len(rews) - start)
+        eps = [e for e in eps if len(e["acts"]) >= 2]
+        if not eps:
+            raise ValueError(
+                "DT: dataset has no usable episodes (need >= 2 steps "
+                "ending in done=True)")
+        return eps
+
+    def _sample_batch(self, bs: int) -> Dict[str, jnp.ndarray]:
+        K = self.K
+        rtg = np.zeros((bs, K, 1), np.float32)
+        obs = np.zeros((bs, K, self.obs_dim), np.float32)
+        acts = np.full((bs, K), -1, np.int64)
+        ts = np.zeros((bs, K), np.int64)
+        mask = np.zeros((bs, K), np.float32)
+        for i in range(bs):
+            ep = self._episodes[
+                self._np_rng.integers(len(self._episodes))]
+            n = len(ep["acts"])
+            end = int(self._np_rng.integers(1, n + 1))
+            lo = max(0, end - K)
+            seg = slice(lo, end)
+            L = end - lo
+            # LEFT-pad so the most recent step sits at position K-1,
+            # matching the acting-time context layout
+            rtg[i, K - L:, 0] = ep["rtg"][seg]
+            obs[i, K - L:] = ep["obs"][seg]
+            acts[i, K - L:] = ep["acts"][seg]
+            ts[i, K - L:] = ep["t"][seg]
+            mask[i, K - L:] = 1.0
+        return {k: jnp.asarray(v) for k, v in
+                {"rtg": rtg, "obs": obs, "acts": acts, "ts": ts,
+                 "mask": mask}.items()}
+
+    # ---- training ----
+
+    def _update_impl(self, params, opt_state, batch):
+        def loss_fn(p):
+            logits = self.net.apply({"params": p}, batch["rtg"],
+                                    batch["obs"], batch["acts"],
+                                    batch["ts"])
+            # predict a_t from prefix ending at s_t: the action input
+            # at position t is masked out by construction (the token
+            # order puts a_t AFTER s_t, and attention is causal)
+            targets = jnp.clip(batch["acts"], 0, self.n_actions - 1)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            m = batch["mask"]
+            loss = jnp.sum(nll * m) / jnp.maximum(m.sum(), 1.0)
+            acc = jnp.sum(
+                (jnp.argmax(logits, -1) == targets) * m
+            ) / jnp.maximum(m.sum(), 1.0)
+            return loss, {"action_nll": loss, "action_acc": acc}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        stats = dict(stats)
+        stats["loss"] = loss_val
+        return params, opt_state, stats
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        stats: Dict[str, float] = {}
+        for _ in range(cfg.get("num_iters_per_step", 20)):
+            self.params, self.opt_state, jstats = self._jit_update(
+                self.params, self.opt_state,
+                self._sample_batch(cfg["train_batch_size"]))
+            stats = {k: float(v) for k, v in jstats.items()}
+            self._timesteps_total += cfg["train_batch_size"]
+        return {"num_env_steps_sampled_this_iter": 0,
+                "target_return": self.target_return,
+                **{f"learner/{k}": v for k, v in stats.items()}}
+
+    # ---- acting ----
+
+    def evaluate(self, num_episodes: int = 5,
+                 target_return: Optional[float] = None) -> Dict[str, Any]:
+        """Autoregressive rollouts conditioned on the target return
+        (reference: dt.py evaluate with the rolling context)."""
+        K = self.K
+        tgt = (target_return if target_return is not None
+               else self.target_return)
+        rewards = []
+        for ep in range(num_episodes):
+            o, _ = self.env.reset(seed=20_000 + ep)
+            rtg = np.zeros((1, K, 1), np.float32)
+            obs = np.zeros((1, K, self.obs_dim), np.float32)
+            acts = np.full((1, K), -1, np.int64)
+            ts = np.zeros((1, K), np.int64)
+            remaining = float(tgt)
+            total, done, t = 0.0, False, 0
+            while not done:
+                # roll the window left; write the current step at K-1
+                rtg[0, :-1] = rtg[0, 1:]
+                obs[0, :-1] = obs[0, 1:]
+                acts[0, :-1] = acts[0, 1:]
+                ts[0, :-1] = ts[0, 1:]
+                rtg[0, -1, 0] = remaining
+                obs[0, -1] = np.asarray(o, np.float32)
+                acts[0, -1] = -1  # current action unknown
+                ts[0, -1] = min(t, self.net.max_timestep - 1)
+                logits = np.asarray(self._jit_logits(
+                    self.params, jnp.asarray(rtg), jnp.asarray(obs),
+                    jnp.asarray(acts), jnp.asarray(ts)))[0, -1]
+                a = int(np.argmax(logits))
+                acts[0, -1] = a
+                o, r, term, trunc, _ = self.env.step(a)
+                total += float(r)
+                remaining -= float(r)
+                done = term or trunc
+                t += 1
+            rewards.append(total)
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
