@@ -337,6 +337,37 @@ class LocalAlgorithm(Algorithm):
             "episodes_total": len(rw),
         }
 
+    def _eval_episodes(self, act_fn, num_episodes: int,
+                       seed_base: int = 10_000,
+                       on_reset=None) -> Dict[str, Any]:
+        """Greedy evaluation loop shared by the self-contained
+        algorithms. ``act_fn(obs)`` returns an action (or a joint
+        action dict for a MultiAgentEnv); ``on_reset()`` clears
+        per-episode acting state (LSTM carry, DT context window)."""
+        from ray_tpu.rllib.env import MultiAgentEnv
+        multi = isinstance(self.env, MultiAgentEnv)
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=seed_base + ep)
+            if on_reset is not None:
+                on_reset()
+            total, done = 0.0, False
+            while not done:
+                obs, rews, terms, truncs, _ = self.env.step(act_fn(obs))
+                if multi:
+                    total += float(np.mean(list(rews.values())))
+                    done = bool(terms.get("__all__")
+                                or truncs.get("__all__"))
+                else:
+                    total += float(rews)
+                    done = bool(terms or truncs)
+            rewards.append(total)
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
+
     def save_checkpoint(self) -> Dict[str, Any]:
         import jax
         return {

@@ -19,6 +19,7 @@ from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
 from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
                                              BanditLinTSConfig,
                                              BanditLinUCB,
@@ -36,4 +37,5 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "ES", "ESConfig", "ARS", "ARSConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
            "BanditLinTS", "BanditLinTSConfig",
-           "QMix", "QMixConfig", "R2D2", "R2D2Config", "DT", "DTConfig"]
+           "QMix", "QMixConfig", "R2D2", "R2D2Config", "DT", "DTConfig",
+           "MADDPG", "MADDPGConfig"]

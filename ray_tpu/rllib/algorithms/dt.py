@@ -179,33 +179,51 @@ class DT(LocalAlgorithm):
             raise ValueError(
                 "DT: dataset has no usable episodes (need >= 2 steps "
                 "ending in done=True)")
+        # left-pad each episode with K-1 rows and concatenate into one
+        # flat array per field: every context window is then a uniform
+        # slice and batch assembly is ONE fancy gather per field, no
+        # per-row Python loop
+        K = self.K
+
+        def flat(field, pad_val, dtype):
+            pads = []
+            for e in eps:
+                col = e[field]
+                pad_shape = (K - 1, *col.shape[1:])
+                pads.append(np.full(pad_shape, pad_val, dtype))
+                pads.append(col.astype(dtype))
+            return np.concatenate(pads)
+
+        self._flat = {
+            "obs": flat("obs", 0.0, np.float32),
+            "acts": flat("acts", -1, np.int64),
+            "rtg": flat("rtg", 0.0, np.float32),
+            "t": flat("t", 0, np.int64),
+        }
+        lengths = np.array([len(e["acts"]) for e in eps], np.int64)
+        padded = lengths + (K - 1)
+        self._ep_bases = np.concatenate(
+            [[0], np.cumsum(padded)[:-1]]).astype(np.int64)
+        self._ep_lengths = lengths
         return eps
 
     def _sample_batch(self, bs: int) -> Dict[str, jnp.ndarray]:
+        """One fancy-indexed gather per field from the pre-padded
+        episodes (the window ending at step `end-1` is the uniform
+        padded slice [end-1, end-1+K))."""
         K = self.K
-        rtg = np.zeros((bs, K, 1), np.float32)
-        obs = np.zeros((bs, K, self.obs_dim), np.float32)
-        acts = np.full((bs, K), -1, np.int64)
-        ts = np.zeros((bs, K), np.int64)
-        mask = np.zeros((bs, K), np.float32)
-        for i in range(bs):
-            ep = self._episodes[
-                self._np_rng.integers(len(self._episodes))]
-            n = len(ep["acts"])
-            end = int(self._np_rng.integers(1, n + 1))
-            lo = max(0, end - K)
-            seg = slice(lo, end)
-            L = end - lo
-            # LEFT-pad so the most recent step sits at position K-1,
-            # matching the acting-time context layout
-            rtg[i, K - L:, 0] = ep["rtg"][seg]
-            obs[i, K - L:] = ep["obs"][seg]
-            acts[i, K - L:] = ep["acts"][seg]
-            ts[i, K - L:] = ep["t"][seg]
-            mask[i, K - L:] = 1.0
-        return {k: jnp.asarray(v) for k, v in
-                {"rtg": rtg, "obs": obs, "acts": acts, "ts": ts,
-                 "mask": mask}.items()}
+        ep_ids = self._np_rng.integers(len(self._episodes), size=bs)
+        ends = self._np_rng.integers(1, self._ep_lengths[ep_ids] + 1)
+        local = (ends[:, None] - 1) + np.arange(K)[None]  # padded coords
+        idx = self._ep_bases[ep_ids][:, None] + local     # (bs, K)
+        mask = (local >= K - 1).astype(np.float32)
+        return {
+            "rtg": jnp.asarray(self._flat["rtg"][idx][..., None]),
+            "obs": jnp.asarray(self._flat["obs"][idx]),
+            "acts": jnp.asarray(self._flat["acts"][idx]),
+            "ts": jnp.asarray(self._flat["t"][idx]),
+            "mask": jnp.asarray(mask),
+        }
 
     # ---- training ----
 

@@ -1,0 +1,341 @@
+"""MADDPG — multi-agent DDPG with centralized critics (Lowe et al.
+2017).
+
+Reference analogue: rllib/algorithms/maddpg/ (maddpg.py,
+maddpg_tf_policy.py): each agent i has a decentralized actor
+π_i(o_i) and a CENTRALIZED critic Q_i(s, a_1..a_n) that observes the
+global state and every agent's action during training; execution uses
+only the local actors. Like QMIX, joint transitions don't fit the
+per-policy rollout split, so the algorithm owns its env loop.
+
+TPU-first: per-agent parameters are STACKED on a leading agent axis
+and the whole actor+critic update for all agents runs as one
+``jax.vmap``-ed jitted program — N agents cost one compiled kernel
+launch, not N Python iterations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.env import Box, MultiAgentEnv, _BUILTIN_ENVS, make_env
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MultiAgentTarget1D(MultiAgentEnv):
+    """N agents on a line steer (velocity action in [-1,1]) toward the
+    origin; team reward = -mean(x_i^2) — a minimal smooth cooperative
+    continuous-control env (reference analogue: the MPE spread task
+    used by maddpg tests, reduced to 1D)."""
+
+    HORIZON = 25
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.num_agents = int(config.get("num_agents", 2))
+        self.agent_ids = [f"agent_{i}" for i in range(self.num_agents)]
+        self._rng = np.random.default_rng(config.get("seed"))
+        self.observation_space = Box(-np.inf, np.inf, (1,))
+        self.action_space = Box(-1.0, 1.0, (1,))
+        self._x: Optional[np.ndarray] = None
+        self._t = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._x = self._rng.uniform(-2.0, 2.0, self.num_agents)
+        self._t = 0
+        obs = {a: np.array([self._x[i]], np.float32)
+               for i, a in enumerate(self.agent_ids)}
+        return obs, {a: {} for a in self.agent_ids}
+
+    def step(self, action_dict):
+        for i, a in enumerate(self.agent_ids):
+            v = float(np.clip(np.asarray(action_dict[a]).ravel()[0],
+                              -1.0, 1.0))
+            self._x[i] += 0.2 * v
+        self._t += 1
+        team_r = float(-np.mean(self._x ** 2))
+        done = self._t >= self.HORIZON
+        obs = {a: np.array([self._x[i]], np.float32)
+               for i, a in enumerate(self.agent_ids)}
+        rews = {a: team_r for a in self.agent_ids}
+        terms = {a: False for a in self.agent_ids}
+        truncs = {a: done for a in self.agent_ids}
+        terms["__all__"] = False
+        truncs["__all__"] = done
+        return obs, rews, terms, truncs, {a: {} for a in self.agent_ids}
+
+
+_BUILTIN_ENVS["MultiAgentTarget1D"] = MultiAgentTarget1D
+
+
+class _Actor(nn.Module):
+    act_dim: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, obs):
+        x = nn.relu(nn.Dense(self.hidden)(obs))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return jnp.tanh(nn.Dense(self.act_dim)(x))
+
+
+class _CentralCritic(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, state, joint_act):
+        x = jnp.concatenate([state, joint_act], axis=-1)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MADDPG)
+        self._config.update({
+            "env": "MultiAgentTarget1D",
+            "actor_lr": 3e-4,
+            "critic_lr": 1e-3,
+            "tau": 0.01,
+            "exploration_noise": 0.3,
+            "replay_buffer_capacity": 50_000,
+            "learning_starts": 500,
+            "train_batch_size": 128,
+            "rollout_fragment_length": 50,
+            "training_intensity": 2,
+            # targets polyak-update every learn step with `tau` (no
+            # hard-sync period knob, unlike DQN/QMIX/R2D2)
+        })
+
+
+class MADDPG(LocalAlgorithm):
+    _default_config_cls = MADDPGConfig
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        self.env = make_env(cfg["env"], cfg.get("env_config"))
+        if not isinstance(self.env, MultiAgentEnv):
+            raise ValueError("MADDPG needs a MultiAgentEnv")
+        if not isinstance(self.env.action_space, Box):
+            raise ValueError("MADDPG is continuous-action only")
+        self.agent_ids = list(self.env.agent_ids)
+        self.n = len(self.agent_ids)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.act_dim = int(np.prod(self.env.action_space.shape))
+        self.low = np.asarray(self.env.action_space.low, np.float32)
+        self.high = np.asarray(self.env.action_space.high, np.float32)
+
+        self.actor = _Actor(self.act_dim)
+        self.critic = _CentralCritic()
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        ka, kc = jax.random.split(self._next_rng())
+        # stacked per-agent params: every leaf gains a leading (n,) axis
+        state_dim = self.n * self.obs_dim
+        joint_dim = self.n * self.act_dim
+
+        def init_one(i):
+            a = self.actor.init(jax.random.fold_in(ka, i),
+                                jnp.zeros((1, self.obs_dim)))["params"]
+            c = self.critic.init(jax.random.fold_in(kc, i),
+                                 jnp.zeros((1, state_dim)),
+                                 jnp.zeros((1, joint_dim)))["params"]
+            return {"actor": a, "critic": c}
+
+        per_agent = [init_one(i) for i in range(self.n)]
+        self.params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_agent)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        # slow actors against fast critics — the standard MADDPG
+        # stabilization: each critic's target moves with the OTHER
+        # agents' actors, so actor updates must trail critic fitting
+        self.optimizer = optax.multi_transform(
+            {"actor": optax.chain(optax.clip_by_global_norm(10.0),
+                                  optax.adam(cfg["actor_lr"])),
+             "critic": optax.chain(optax.clip_by_global_norm(10.0),
+                                   optax.adam(cfg["critic_lr"]))},
+            param_labels={"actor": "actor", "critic": "critic"})
+        self.opt_state = self.optimizer.init(self.params)
+        self._jit_act = jax.jit(self._act_impl)
+        self._jit_update = jax.jit(self._update_impl)
+
+        self.replay = ReplayBuffer(cfg["replay_buffer_capacity"],
+                                   seed=cfg.get("seed"))
+        self._init_local_state()
+        self._obs, _ = self.env.reset(seed=cfg.get("seed"))
+        self._episode_reward = 0.0
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- jitted programs ----
+
+    def _act_impl(self, params, obs):
+        """obs (n, do) -> per-agent deterministic actions (n, da)."""
+        return jax.vmap(
+            lambda p, o: self.actor.apply({"params": p}, o[None])[0]
+        )(params["actor"], obs)
+
+    def _update_impl(self, params, target_params, opt_state, batch):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        obs = batch["obs"]           # (B, n, do)
+        nobs = batch["next_obs"]
+        acts = batch["actions"]      # (B, n, da) in tanh space
+        rews = batch["rewards"]      # (B,) team
+        not_done = 1.0 - batch["dones"].astype(jnp.float32)
+        b = obs.shape[0]
+        state = obs.reshape(b, -1)
+        nstate = nobs.reshape(b, -1)
+        joint_act = acts.reshape(b, -1)
+
+        # target joint action from all target actors: (B, n, da)
+        next_a = jax.vmap(
+            lambda p, o: self.actor.apply({"params": p}, o),
+            in_axes=(0, 1), out_axes=1)(target_params["actor"], nobs)
+        njoint = next_a.reshape(b, -1)
+
+        def per_agent_critic_target(tc):
+            return self.critic.apply({"params": tc}, nstate, njoint)
+        tq = jax.vmap(per_agent_critic_target)(
+            target_params["critic"])          # (n, B)
+        y = jax.lax.stop_gradient(
+            rews[None, :] + gamma * not_done[None, :] * tq)  # (n, B)
+
+        def loss_fn(p):
+            # critic: every agent's Q(s, a_all) regresses its target
+            q = jax.vmap(
+                lambda c: self.critic.apply({"params": c}, state,
+                                            joint_act)
+            )(p["critic"])                    # (n, B)
+            critic_loss = jnp.mean((q - y) ** 2)
+
+            # actor i: own action from π_i, others from the batch
+            own = jax.vmap(
+                lambda a, o: self.actor.apply({"params": a}, o),
+                in_axes=(0, 1), out_axes=0)(p["actor"], obs)  # (n, B, da)
+            idx = jnp.arange(self.n)
+
+            def actor_q(i):
+                mixed = acts.at[:, i].set(own[i])
+                frozen = jax.lax.stop_gradient(
+                    jax.tree_util.tree_map(lambda x: x[i], p["critic"]))
+                return self.critic.apply({"params": frozen}, state,
+                                         mixed.reshape(b, -1))
+            actor_loss = -jnp.mean(jax.vmap(actor_q)(idx))
+            total = critic_loss + actor_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "mean_q": jnp.mean(q)}
+
+        (loss_val, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        tau = cfg.get("tau", 0.01)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p_: (1 - tau) * t + tau * p_, target_params,
+            params)
+        stats = dict(stats)
+        stats["loss"] = loss_val
+        return params, target_params, opt_state, stats
+
+    # ---- collection ----
+
+    def _joint_actions(self, obs_dict, noise: float,
+                       uniform: bool = False):
+        obs = np.stack([obs_dict[a] for a in self.agent_ids])
+        if uniform:
+            # pure-random warmup decorrelates the agents' actions so
+            # each centralized critic can attribute per-slot effects
+            # (without it, early actor drift saturates every action at
+            # ±1 and the joint-action landscape is unlearnable)
+            raw = self._np_rng.uniform(
+                -1.0, 1.0, (self.n, self.act_dim)).astype(np.float32)
+        else:
+            raw = np.asarray(self._jit_act(self.params,
+                                           jnp.asarray(obs)))  # (n, da)
+        if noise > 0 and not uniform:
+            raw = np.clip(raw + self._np_rng.normal(
+                0.0, noise, raw.shape).astype(np.float32), -1.0, 1.0)
+        scaled = self.low + (raw + 1.0) * 0.5 * (self.high - self.low)
+        return ({a: scaled[i] for i, a in enumerate(self.agent_ids)},
+                raw)
+
+    def _collect(self, num_steps: int, noise: float) -> int:
+        rows: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "rewards", "dones",
+                                  "next_obs")}
+        warmup = len(self.replay) < self.config["learning_starts"]
+        for _ in range(num_steps):
+            acts, raw = self._joint_actions(self._obs, noise,
+                                            uniform=warmup)
+            nobs, rews, terms, truncs, _ = self.env.step(acts)
+            terminal = bool(terms.get("__all__"))
+            done = terminal or bool(truncs.get("__all__"))
+            team_r = float(np.mean([rews[a] for a in self.agent_ids]))
+            rows["obs"].append(
+                np.stack([self._obs[a] for a in self.agent_ids]))
+            rows["actions"].append(raw)
+            rows["rewards"].append(np.float32(team_r))
+            rows["dones"].append(terminal)  # bootstrap through truncation
+            rows["next_obs"].append(np.stack(
+                [nobs.get(a, self._obs[a]) for a in self.agent_ids]))
+            self._episode_reward += team_r
+            if done:
+                self._episode_reward_window.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nobs
+        self.replay.add(SampleBatch(
+            {k: np.stack(v) if np.asarray(v[0]).ndim
+             else np.asarray(v) for k, v in rows.items()}))
+        return num_steps
+
+    # ---- Algorithm surface ----
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = self._collect(cfg["rollout_fragment_length"],
+                          cfg["exploration_noise"])
+        self._timesteps_total += n
+        stats: Dict[str, float] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(max(1, cfg.get("training_intensity", 1))):
+                train = self.replay.sample(cfg["train_batch_size"])
+                jbatch = {k: jnp.asarray(v) for k, v in train.items()
+                          if isinstance(v, np.ndarray)
+                          and v.dtype != object}
+                (self.params, self.target_params, self.opt_state,
+                 jstats) = self._jit_update(
+                    self.params, self.target_params, self.opt_state,
+                    jbatch)
+                stats = {k: float(v) for k, v in jstats.items()}
+        return {
+            "num_env_steps_sampled_this_iter": n,
+            "replay_size": len(self.replay),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        out = self._eval_episodes(
+            lambda obs: self._joint_actions(obs, noise=0.0)[0],
+            num_episodes, seed_base=30_000)
+        self._obs, _ = self.env.reset()
+        self._episode_reward = 0.0
+        return out

@@ -291,26 +291,14 @@ class QMix(LocalAlgorithm):
         }
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
-        rewards = []
-        for ep in range(num_episodes):
-            obs, _ = self.env.reset(seed=10_000 + ep)
-            total, done = 0.0, False
-            while not done:
-                acts = self._joint_actions(obs, epsilon=0.0)
-                obs, rews, terms, truncs, _ = self.env.step(acts)
-                total += float(np.mean(list(rews.values())))
-                done = bool(terms.get("__all__")
-                            or truncs.get("__all__"))
-            rewards.append(total)
+        out = self._eval_episodes(
+            lambda obs: self._joint_actions(obs, epsilon=0.0),
+            num_episodes)
         # restore the training env stream; the interrupted episode's
         # partial reward must not leak into the next episode's metric
         self._obs, _ = self.env.reset()
         self._episode_reward = 0.0
-        return {"evaluation": {
-            "episode_reward_mean": float(np.mean(rewards)),
-            "episode_reward_min": float(np.min(rewards)),
-            "episode_reward_max": float(np.max(rewards)),
-        }}
+        return out
 
     def compute_joint_actions(self, obs_dict):
         """Greedy joint action for deployment."""

@@ -296,24 +296,19 @@ class R2D2(LocalAlgorithm):
         }
 
     def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
-        rewards = []
-        for ep in range(num_episodes):
-            obs, _ = self.env.reset(seed=10_000 + ep)
-            carry = zero_carry(1, self.config["lstm_size"])
-            total, done = 0.0, False
-            while not done:
-                carry, q = self._jit_step(
-                    self.params, carry,
-                    jnp.asarray(obs, jnp.float32)[None])
-                obs, r, term, trunc, _ = self.env.step(
-                    int(np.argmax(np.asarray(q)[0])))
-                total += float(r)
-                done = term or trunc
-            rewards.append(total)
+        carry_box = [zero_carry(1, self.config["lstm_size"])]
+
+        def reset_carry():
+            carry_box[0] = zero_carry(1, self.config["lstm_size"])
+
+        def act(obs):
+            carry_box[0], q = self._jit_step(
+                self.params, carry_box[0],
+                jnp.asarray(obs, jnp.float32)[None])
+            return int(np.argmax(np.asarray(q)[0]))
+
+        out = self._eval_episodes(act, num_episodes,
+                                  on_reset=reset_carry)
         self._reset_episode()
-        return {"evaluation": {
-            "episode_reward_mean": float(np.mean(rewards)),
-            "episode_reward_min": float(np.min(rewards)),
-            "episode_reward_max": float(np.max(rewards)),
-        }}
+        return out
 

@@ -251,12 +251,12 @@ def test_bandit_lints_converges():
 
 
 def test_algorithms_registry_exports():
-    """All 25 algorithm classes import from the package root."""
+    """All 26 algorithm classes import from the package root."""
     from ray_tpu.rllib import algorithms as A
     for name in ["PPO", "DDPPO", "APPO", "IMPALA", "DQN", "SimpleQ",
                  "ApexDQN", "ApexDDPG", "R2D2", "PG", "A2C", "A3C",
                  "SAC", "DDPG", "TD3", "BC", "MARWIL", "CQL", "CRR",
-                 "DT", "ES", "ARS", "QMix", "BanditLinUCB",
+                 "DT", "ES", "ARS", "QMix", "MADDPG", "BanditLinUCB",
                  "BanditLinTS"]:
         assert hasattr(A, name), name
         assert hasattr(A, name + "Config"), name

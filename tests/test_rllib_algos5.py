@@ -107,3 +107,44 @@ def test_r2d2_learns_cartpole():
     algo.load_checkpoint(st)
     algo.cleanup()
     assert best > 60, f"R2D2 stuck at {best}"
+
+
+def test_maddpg_centralized_critic_machinery():
+    from ray_tpu.rllib.algorithms.maddpg import MADDPGConfig
+    algo = (MADDPGConfig().environment("MultiAgentTarget1D",
+                                       env_config={"num_agents": 2})
+            .training(learning_starts=200, train_batch_size=64,
+                      rollout_fragment_length=50)
+            .debugging(seed=0).build())
+    for _ in range(8):
+        r = algo.step()
+    assert r["replay_size"] >= 400
+    assert np.isfinite(r["learner/critic_loss"])
+    # per-agent params are stacked on a leading (n,) axis
+    import jax
+    leaves = jax.tree_util.tree_leaves(algo.params["actor"])
+    assert all(l.shape[0] == 2 for l in leaves)
+    st = algo.save_checkpoint()
+    algo.load_checkpoint(st)
+    algo.cleanup()
+
+
+def test_maddpg_learns_rendezvous():
+    """3 agents converge to the origin: eval climbs from ≈ -45
+    (untrained) toward the ≈ -3 optimum."""
+    from ray_tpu.rllib.algorithms.maddpg import MADDPGConfig
+    algo = (MADDPGConfig().environment("MultiAgentTarget1D",
+                                       env_config={"num_agents": 3})
+            .training(learning_starts=500, train_batch_size=128,
+                      training_intensity=4)
+            .debugging(seed=0).build())
+    best = -1e9
+    for i in range(160):
+        algo.step()
+        if (i + 1) % 20 == 0:
+            ev = algo.evaluate(num_episodes=4)["evaluation"]
+            best = max(best, ev["episode_reward_mean"])
+            if best > -10:
+                break
+    algo.cleanup()
+    assert best > -15, f"MADDPG stuck at {best}"
