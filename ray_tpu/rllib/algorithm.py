@@ -337,6 +337,44 @@ class LocalAlgorithm(Algorithm):
             "episodes_total": len(rw),
         }
 
+    def _collect_joint(self, act_fn, num_steps: int) -> int:
+        """Joint-transition collector shared by the cooperative
+        multi-agent algorithms (QMIX, MADDPG). ``act_fn(obs_dict)``
+        returns (env_action_dict, stored_action_array (n, ...)); rows
+        carry the TEAM reward (mean over agents), terminal-only dones
+        (TD bootstraps through time-limit truncation), and stacked
+        per-agent obs. Appends one SampleBatch to ``self.replay``."""
+        rows: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "rewards", "dones",
+                                  "next_obs")}
+        for _ in range(num_steps):
+            acts, stored = act_fn(self._obs)
+            nobs, rews, terms, truncs, _ = self.env.step(acts)
+            terminal = bool(terms.get("__all__"))
+            done = terminal or bool(truncs.get("__all__"))
+            team_r = float(np.mean([rews[a] for a in self.agent_ids]))
+            rows["obs"].append(
+                np.stack([self._obs[a] for a in self.agent_ids]))
+            rows["actions"].append(stored)
+            rows["rewards"].append(np.float32(team_r))
+            rows["dones"].append(terminal)
+            # on terminal, next obs may be missing for done agents:
+            # fall back to the last obs (masked out by dones in the TD)
+            rows["next_obs"].append(np.stack(
+                [nobs.get(a, self._obs[a]) for a in self.agent_ids]))
+            self._episode_reward += team_r
+            if done:
+                self._episode_reward_window.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nobs
+        from ray_tpu.rllib.sample_batch import SampleBatch
+        self.replay.add(SampleBatch(
+            {k: np.stack(v) if np.asarray(v[0]).ndim
+             else np.asarray(v) for k, v in rows.items()}))
+        return num_steps
+
     def _eval_episodes(self, act_fn, num_episodes: int,
                        seed_base: int = 10_000,
                        on_reset=None) -> Dict[str, Any]:

@@ -120,11 +120,10 @@ class DT(LocalAlgorithm):
         path = cfg.get("input_path")
         if not path:
             raise ValueError("DT needs config['input_path']")
-        self._episodes = self._segment(JsonReader(path).read_all())
-        best_ret = max(float(ep["rtg"][0]) for ep in self._episodes)
+        self._segment(JsonReader(path).read_all())
         self.target_return = (cfg["target_return"]
                               if cfg["target_return"] is not None
-                              else best_ret)
+                              else self._best_return)
 
         self.net = _DTNet(self.obs_dim, self.n_actions, self.K,
                           cfg["embed_dim"], cfg["num_heads"],
@@ -205,14 +204,16 @@ class DT(LocalAlgorithm):
         self._ep_bases = np.concatenate(
             [[0], np.cumsum(padded)[:-1]]).astype(np.int64)
         self._ep_lengths = lengths
-        return eps
+        self._best_return = max(float(e["rtg"][0]) for e in eps)
+        # the flat arrays are the training store; the per-episode
+        # copies would double resident memory — drop them
 
     def _sample_batch(self, bs: int) -> Dict[str, jnp.ndarray]:
         """One fancy-indexed gather per field from the pre-padded
         episodes (the window ending at step `end-1` is the uniform
         padded slice [end-1, end-1+K))."""
         K = self.K
-        ep_ids = self._np_rng.integers(len(self._episodes), size=bs)
+        ep_ids = self._np_rng.integers(len(self._ep_lengths), size=bs)
         ends = self._np_rng.integers(1, self._ep_lengths[ep_ids] + 1)
         local = (ends[:, None] - 1) + np.arange(K)[None]  # padded coords
         idx = self._ep_bases[ep_ids][:, None] + local     # (bs, K)

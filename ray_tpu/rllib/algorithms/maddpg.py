@@ -277,35 +277,12 @@ class MADDPG(LocalAlgorithm):
                 raw)
 
     def _collect(self, num_steps: int, noise: float) -> int:
-        rows: Dict[str, list] = {k: [] for k in
-                                 ("obs", "actions", "rewards", "dones",
-                                  "next_obs")}
         warmup = len(self.replay) < self.config["learning_starts"]
-        for _ in range(num_steps):
-            acts, raw = self._joint_actions(self._obs, noise,
-                                            uniform=warmup)
-            nobs, rews, terms, truncs, _ = self.env.step(acts)
-            terminal = bool(terms.get("__all__"))
-            done = terminal or bool(truncs.get("__all__"))
-            team_r = float(np.mean([rews[a] for a in self.agent_ids]))
-            rows["obs"].append(
-                np.stack([self._obs[a] for a in self.agent_ids]))
-            rows["actions"].append(raw)
-            rows["rewards"].append(np.float32(team_r))
-            rows["dones"].append(terminal)  # bootstrap through truncation
-            rows["next_obs"].append(np.stack(
-                [nobs.get(a, self._obs[a]) for a in self.agent_ids]))
-            self._episode_reward += team_r
-            if done:
-                self._episode_reward_window.append(self._episode_reward)
-                self._episode_reward = 0.0
-                self._obs, _ = self.env.reset()
-            else:
-                self._obs = nobs
-        self.replay.add(SampleBatch(
-            {k: np.stack(v) if np.asarray(v[0]).ndim
-             else np.asarray(v) for k, v in rows.items()}))
-        return num_steps
+
+        def act(obs_dict):
+            return self._joint_actions(obs_dict, noise, uniform=warmup)
+
+        return self._collect_joint(act, num_steps)
 
     # ---- Algorithm surface ----
 

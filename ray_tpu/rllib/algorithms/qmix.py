@@ -231,38 +231,12 @@ class QMix(LocalAlgorithm):
         return {a: int(acts[i]) for i, a in enumerate(self.agent_ids)}
 
     def _collect(self, num_steps: int, epsilon: float) -> int:
-        rows: Dict[str, list] = {k: [] for k in
-                                 ("obs", "actions", "rewards", "dones",
-                                  "next_obs")}
-        for _ in range(num_steps):
-            acts = self._joint_actions(self._obs, epsilon)
-            nobs, rews, terms, truncs, _ = self.env.step(acts)
-            terminal = bool(terms.get("__all__"))
-            done = terminal or bool(truncs.get("__all__"))
-            team_r = float(np.mean([rews[a] for a in self.agent_ids]))
-            rows["obs"].append(
-                np.stack([self._obs[a] for a in self.agent_ids]))
-            rows["actions"].append(
-                np.array([acts[a] for a in self.agent_ids], np.int64))
-            rows["rewards"].append(np.float32(team_r))
-            # TD bootstraps THROUGH time-limit truncation; only true
-            # termination zeroes the target
-            rows["dones"].append(terminal)
-            # on terminal, next obs may be missing for done agents:
-            # fall back to the last obs (masked out by dones in the TD)
-            rows["next_obs"].append(np.stack(
-                [nobs.get(a, self._obs[a]) for a in self.agent_ids]))
-            self._episode_reward += team_r
-            if done:
-                self._episode_reward_window.append(self._episode_reward)
-                self._episode_reward = 0.0
-                self._obs, _ = self.env.reset()
-            else:
-                self._obs = nobs
-        self.replay.add(SampleBatch(
-            {k: np.stack(v) if np.asarray(v[0]).ndim
-             else np.asarray(v) for k, v in rows.items()}))
-        return num_steps
+        def act(obs_dict):
+            acts = self._joint_actions(obs_dict, epsilon)
+            stored = np.array([acts[a] for a in self.agent_ids],
+                              np.int64)
+            return acts, stored
+        return self._collect_joint(act, num_steps)
 
     # ---- Trainable / Algorithm surface ----
 

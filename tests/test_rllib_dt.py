@@ -54,12 +54,18 @@ def test_dt_segmentation_rtg(cartpole_dataset):
             .offline_data(input_path=path)
             .training(context_length=4, num_iters_per_step=1)
             .debugging(seed=0).build())
-    eps = algo._episodes
-    assert len(eps) >= 100
-    for ep in eps[:5]:
-        # return-to-go decreases by the per-step reward (1.0)
-        assert ep["rtg"][0] == pytest.approx(len(ep["acts"]))
-        assert ep["rtg"][-1] == pytest.approx(1.0)
+    assert len(algo._ep_lengths) >= 100
+    K = algo.K
+    for i in range(5):
+        # episode i's rows sit after K-1 pad rows in the flat store;
+        # return-to-go starts at the episode length (reward 1.0/step)
+        n = int(algo._ep_lengths[i])
+        lo = int(algo._ep_bases[i]) + K - 1
+        rtg = algo._flat["rtg"][lo:lo + n]
+        assert rtg[0] == pytest.approx(n)
+        assert rtg[-1] == pytest.approx(1.0)
+        # the pad rows carry sentinel action -1
+        assert (algo._flat["acts"][lo - (K - 1):lo] == -1).all()
     # default target = best dataset return
     assert algo.target_return == pytest.approx(best)
     algo.cleanup()

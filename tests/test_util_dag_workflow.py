@@ -239,7 +239,9 @@ def test_queue_no_thread_starvation(ray_start_shared):
     results = []
 
     def consumer():
-        results.append(q.get(timeout=30))
+        # generous timeout: 10 pollers share one client connection, and
+        # under full-suite load a poll round-trip can take seconds
+        results.append(q.get(timeout=120))
 
     threads = [threading.Thread(target=consumer) for _ in range(10)]
     for t in threads:
@@ -248,7 +250,8 @@ def test_queue_no_thread_starvation(ray_start_shared):
     for i in range(10):
         q.put(i)
     for t in threads:
-        t.join(timeout=60)
+        t.join(timeout=150)
+    assert not any(t.is_alive() for t in threads), "getters starved"
     assert sorted(results) == list(range(10))
 
 
