@@ -69,21 +69,20 @@ def _read_file(path: str) -> bytes:
 def _chips_from_accel_type(accel: str) -> Optional[int]:
     """Per-host chip count from an accelerator type like
     'v5litepod-16' / 'v4-32': total chips divided by slice host count
-    (v4 counts cores, 2/chip)."""
-    try:
-        gen, _, total_s = accel.partition("-")
-        total = int(total_s)
-        if gen in ("v2", "v3", "v4", "v5p"):
-            total //= 2  # "-N" counts cores on these gens
-        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-        hosts = max(1, len([h for h in hostnames.split(",") if h]))
-        per_host = max(1, total // hosts)
-        # physical per-host ceiling guards the common misconfig of a
-        # multi-host slice without TPU_WORKER_HOSTNAMES set: no host
-        # has more than 8 chips (v5e) / 4 chips (other gens)
-        return min(per_host, 8 if gen.startswith("v5lite") else 4)
-    except (ValueError, ZeroDivisionError):
+    (the suffix counts TensorCores, 2/chip, on v2/v3/v4/v5p — parsing
+    shared with the autoscaler via common/tpu.py)."""
+    from ray_tpu.common.tpu import max_chips_per_host, slice_chips
+    gen = accel.partition("-")[0]
+    total = slice_chips(accel)
+    if total is None or total <= 0:
         return None
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = max(1, len([h for h in hostnames.split(",") if h]))
+    per_host = max(1, total // hosts)
+    # physical per-host ceiling guards the common misconfig of a
+    # multi-host slice without TPU_WORKER_HOSTNAMES set: no host
+    # has more than 8 chips (v5e) / 4 chips (other gens)
+    return min(per_host, max_chips_per_host(gen))
 
 
 _MDS_CACHE: List[Optional[int]] = []

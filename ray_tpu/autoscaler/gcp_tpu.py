@@ -19,11 +19,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
-
-# chips per host is fixed at 4 across v4/v5e/v5p/v6e TPU-VM hosts
-ACCELERATOR_CHIPS = {
-    "v4": 4, "v5litepod": 4, "v5e": 4, "v5p": 4, "v6e": 4,
-}
+from ray_tpu.common.tpu import slice_topology
 
 
 class TPUApiClient:
@@ -160,19 +156,17 @@ class GCPTPUNodeProvider(NodeProvider):
 
     def node_resources(self, node_id: str) -> Dict[str, float]:
         acc = self._accelerator_type(node_id)
-        # "v5litepod-8": suffix = chips in the slice; 4 chips per host
-        if "-" in acc:
-            family, n = acc.rsplit("-", 1)
-            try:
-                chips = int(n)
-            except ValueError:
-                return {"TPU": 0.0}
-            per_host = ACCELERATOR_CHIPS.get(family, 4)
-            hosts = max(1, chips // per_host)
-            return {"TPU": float(chips),
-                    "CPU": 96.0 * hosts,  # typical TPU-VM host vCPUs
-                    "tpu_slice": 1.0}
-        return {"TPU": 0.0}
+        # common/tpu.py is the single source of truth for the
+        # accelerator-type suffix (TensorCores on v2/v3/v4/v5p, chips on
+        # v5e/v6e) so advertised capacity matches what the slice's
+        # raylets will register via _chips_from_accel_type.
+        topo = slice_topology(acc)
+        if topo is None:
+            return {"TPU": 0.0}
+        chips, hosts = topo
+        return {"TPU": float(chips),
+                "CPU": 96.0 * hosts,  # typical TPU-VM host vCPUs
+                "tpu_slice": 1.0}
 
     def node_state(self, node_id: str) -> str:
         out = self.api.request("GET", f"queuedResources/{node_id}")

@@ -1,0 +1,53 @@
+"""TPU accelerator-type topology parsing shared by the raylet (chip
+detection) and the autoscaler (slice capacity advertisement).
+
+Reference analogue: python/ray/_private/resource_spec.py:268
+(_autodetect_num_gpus) — the reference parses CUDA devices; here the
+unit is the TPU accelerator-type string ("v4-32", "v5litepod-16").
+
+One parsing rule, used everywhere: the "-N" suffix counts TensorCores
+(2 per chip) on v2/v3/v4/v5p, and chips on v5e (v5litepod) / v6e.
+Keeping a single helper means the autoscaler's advertised capacity
+always matches what the slice's raylets will actually register.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Generations whose accelerator-type suffix counts TensorCores, not chips.
+_CORE_SUFFIX_GENS = ("v2", "v3", "v4", "v5p")
+
+
+def slice_chips(accel: str) -> Optional[int]:
+    """Total chips in the slice named by an accelerator type, or None if
+    the string is unparseable."""
+    gen, _, total_s = accel.partition("-")
+    try:
+        total = int(total_s)
+    except ValueError:
+        return None
+    if gen in _CORE_SUFFIX_GENS:
+        total //= 2
+    return total
+
+
+def max_chips_per_host(gen: str) -> int:
+    """Physical per-host chip ceiling: 8 for v5e single-host (2x4
+    topology), 4 for every other TPU-VM generation."""
+    return 8 if (gen.startswith("v5lite") or gen == "v5e") else 4
+
+
+def slice_topology(accel: str) -> Optional[Tuple[int, int]]:
+    """(total_chips, hosts) for a slice, deriving hosts from the
+    standard GCE TPU-VM layout: multi-host slices place 4 chips per
+    host on every generation; a slice that fits the single-host ceiling
+    (8 chips for v5e, 4 otherwise) is one host.
+    """
+    gen = accel.partition("-")[0]
+    total = slice_chips(accel)
+    if total is None:
+        return None
+    if total <= max_chips_per_host(gen):
+        return total, 1
+    return total, max(1, total // 4)

@@ -31,6 +31,7 @@ class _ConnTable:
     def __init__(self):
         self.refs: Dict[str, Any] = {}  # ref hex -> real ObjectRef
         self.actors: Dict[str, Any] = {}  # actor hex -> real ActorHandle
+        self.exports: Dict[str, Any] = {}  # sha -> (deserialized fn/cls, kind)
 
     def track_ref(self, ref) -> str:
         h = ref.hex()
@@ -101,7 +102,6 @@ class ClientServer:
         from concurrent.futures import ThreadPoolExecutor
         self._exec = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix="ray-client-srv")
-        self._exports: Dict[str, Any] = {}  # sha -> deserialized fn/cls
         self._io = protocol.EventLoopThread("ray-client-server")
         self._server = protocol.Server(self._handlers())
         self.port = self._io.run(self._server.start_tcp(host, port))
@@ -169,11 +169,18 @@ class ClientServer:
                 t.refs.pop(h, None)
 
         async def client_export(payload, conn):
+            # exports live in the per-connection table (freed on
+            # disconnect) so a long-lived head serving many client
+            # sessions doesn't grow memory without bound; the client's
+            # sha->key cache is per-connection too, so re-export after
+            # reconnect is automatic.
+            t = table(conn)
+
             def _do():
                 sha = hashlib.sha256(payload["data"]).hexdigest()[:32]
-                if sha not in self._exports:
-                    self._exports[sha] = (cloudpickle.loads(payload["data"]),
-                                          payload.get("kind", "fn"))
+                if sha not in t.exports:
+                    t.exports[sha] = (cloudpickle.loads(payload["data"]),
+                                      payload.get("kind", "fn"))
                 return sha
             return await _run(_do)
 
@@ -181,7 +188,7 @@ class ClientServer:
             t = table(conn)
 
             def _do():
-                fn, _ = self._exports[payload["key"]]
+                fn, _ = t.exports[payload["key"]]
                 args, kwargs = _server_loads(payload["args"], t)
                 opts = payload.get("opts") or {}
                 rf = ray_tpu.remote(fn) if not opts else \
@@ -196,7 +203,7 @@ class ClientServer:
             t = table(conn)
 
             def _do():
-                cls, _ = self._exports[payload["key"]]
+                cls, _ = t.exports[payload["key"]]
                 args, kwargs = _server_loads(payload["args"], t)
                 opts = payload.get("opts") or {}
                 ac = ray_tpu.remote(cls) if not opts else \
