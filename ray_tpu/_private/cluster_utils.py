@@ -65,6 +65,33 @@ class Cluster:
         self.worker_nodes.append(info)
         return info
 
+    def add_nodes(self, count: int, **args) -> List[Dict[str, Any]]:
+        """Spawn ``count`` identical raylets concurrently (scale tests:
+        the 50-node simulated cluster comes up in one wave instead of
+        paying a serial ready-wait per node)."""
+        import json as _json
+        import os as _os
+        from ray_tpu.common.ids import NodeID
+        procs = []
+        for _ in range(count):
+            node_id = NodeID.from_random().hex()
+            proc = node_mod.start_raylet(
+                self.session_dir, self.gcs_address, node_id,
+                self._res(args), args.get("labels") or {}, is_head=False,
+                object_store_memory=args.get("object_store_memory"))
+            procs.append((node_id, proc))
+        infos = []
+        for node_id, proc in procs:
+            info = _json.loads(node_mod._wait_file(
+                _os.path.join(self.session_dir,
+                              f"raylet_{node_id[:8]}.json"),
+                timeout=120.0))
+            info["proc"] = proc
+            info["node_id"] = node_id
+            self.worker_nodes.append(info)
+            infos.append(info)
+        return infos
+
     def remove_node(self, info: Dict[str, Any], allow_graceful: bool = False):
         proc = info["proc"]
         if allow_graceful:

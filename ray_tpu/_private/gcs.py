@@ -827,21 +827,29 @@ class GcsServer:
         pg_id = payload["pg_id"]
         bundles: List[Dict[str, float]] = payload["bundles"]
         strategy = payload.get("strategy", "PACK")
-        assignment = self._place_bundles(bundles, strategy)
-        if assignment is None:
+
+        def park_pending():
+            # a PG that can't place NOW is queued and retried as
+            # resources free up — it must never be dropped (a burst of
+            # creations all reading the same stale reports routinely
+            # fails the 2-phase prepare; reference:
+            # gcs_placement_group_manager's pending queue)
             self.placement_groups[pg_id] = {
                 "pg_id": pg_id, "state": "PENDING", "bundles": bundles,
                 "strategy": strategy, "assignment": None,
                 "name": payload.get("name"),
             }
             self._persist_pg(pg_id)
-            # retry in background as resources free up
             asyncio.get_running_loop().create_task(
                 self._retry_pg(pg_id))
             return {"state": "PENDING"}
+
+        assignment = self._place_bundles(bundles, strategy)
+        if assignment is None:
+            return park_pending()
         ok = await self._commit_bundles(pg_id, bundles, assignment)
         if not ok:
-            return {"state": "PENDING"}
+            return park_pending()
         self.placement_groups[pg_id] = {
             "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
             "strategy": strategy, "assignment": assignment,
@@ -850,15 +858,35 @@ class GcsServer:
         self._persist_pg(pg_id)
         return {"state": "CREATED", "assignment": assignment}
 
+    def _pg_ever_feasible(self, bundles) -> bool:
+        """Can the CURRENT cluster's totals ever host every bundle?
+        (Pending PGs demanding more than any node will ever have back
+        off hard instead of re-running placement every interval.)"""
+        totals = [dict(n.total_resources) for n in self.nodes.values()
+                  if n.alive]
+        for b in bundles:
+            if not any(all(t.get(k, 0) >= v for k, v in b.items())
+                       for t in totals):
+                return False
+        return True
+
     async def _retry_pg(self, pg_id: str):
-        deadline = time.monotonic() + 300
-        while time.monotonic() < deadline:
-            await asyncio.sleep(0.5)
+        # retries until the PG places or is removed (pending PGs are
+        # legitimate under autoscaling — capacity may yet arrive); the
+        # interval backs off so hundreds of pending PGs cost the loop
+        # little, and never-satisfiable ones poll at the slowest rate
+        delay = 0.25
+        while True:
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 2.0)
             pg = self.placement_groups.get(pg_id)
             if pg is None:
                 return
             if pg["state"] != "PENDING":
                 return
+            if not self._pg_ever_feasible(pg["bundles"]):
+                delay = 10.0
+                continue
             assignment = self._place_bundles(pg["bundles"], pg["strategy"])
             if assignment is None:
                 continue
@@ -871,8 +899,17 @@ class GcsServer:
                 return
 
     def _place_bundles(self, bundles, strategy) -> Optional[List[str]]:
-        avail = {nid: dict(n.available_resources)
-                 for nid, n in self.nodes.items() if n.alive}
+        # pessimistic view: reported availability folded with this
+        # scheduler's own recent placements, so a burst of concurrent
+        # creations doesn't stampede one node on stale reports
+        avail = {}
+        for nid, n in self.nodes.items():
+            if not n.alive:
+                continue
+            pending = self._pending_for(nid)
+            avail[nid] = {
+                k: self._effective_avail(n, k, pending)
+                for k in set(n.total_resources) | set(pending)}
 
         def fits(nid, bundle):
             return all(avail[nid].get(k, 0) + 1e-9 >= v
@@ -949,8 +986,11 @@ class GcsServer:
                     except Exception:
                         pass
             return False
-        # phase 2: commit
-        for nid, idx in prepared:
+        # phase 2: commit; record the reservations in the ephemeral view
+        # so concurrent placements see them before the next node report
+        for (nid, idx), bundle in zip(prepared, bundles):
+            self._ephemeral_allocs.setdefault(nid, []).append(
+                (time.monotonic(), dict(bundle)))
             try:
                 await self.nodes[nid].conn.call(
                     "commit_bundle", {"pg_id": pg_id, "bundle_index": idx})

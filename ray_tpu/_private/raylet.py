@@ -192,7 +192,8 @@ class WorkerHandle:
 
 
 class PendingTask:
-    __slots__ = ("spec", "reply_fut", "demand", "tpu_demand", "submitted_at")
+    __slots__ = ("spec", "reply_fut", "demand", "tpu_demand", "submitted_at",
+                 "sched_class")
 
     def __init__(self, spec, reply_fut):
         self.spec = spec
@@ -200,6 +201,79 @@ class PendingTask:
         self.demand: Dict[str, float] = dict(spec.get("resources", {}))
         self.tpu_demand = int(self.demand.get("TPU", 0))
         self.submitted_at = time.monotonic()
+        # scheduling class: tasks in one class are interchangeable for
+        # feasibility (same demand, same PG bundle), so the dispatch loop
+        # can skip a whole class once its head is blocked (reference:
+        # cluster_task_manager's per-SchedulingClass queues)
+        pg = spec.get("placement_group") or None
+        bundle = (pg["pg_id"], pg.get("bundle_index", 0)) if pg else None
+        # spilled-in tasks get their own class: they are feasibility-
+        # equivalent but must not block the spillback drain of plain
+        # tasks queued behind them (spilled tasks don't re-spill)
+        self.sched_class = (tuple(sorted(self.demand.items())), bundle,
+                            bool(spec.get("spilled_from")))
+
+
+class PendingQueue:
+    """Per-scheduling-class FIFO queues of PendingTasks.
+
+    The dispatch loop visits class heads instead of every queued task, so
+    draining N homogeneous tasks costs O(N * classes) feasibility checks
+    rather than O(N^2) — the difference between seconds and hours at the
+    10k-queued-task scale envelope (reference:
+    release/benchmarks/README.md:11, local_task_manager.cc per-class
+    dispatch)."""
+
+    def __init__(self):
+        from collections import deque
+        self._deque = deque  # class attr-free local alias
+        self._classes: "Dict[tuple, Any]" = {}
+        self._count = 0
+
+    def append(self, ptask: PendingTask):
+        q = self._classes.get(ptask.sched_class)
+        if q is None:
+            q = self._classes[ptask.sched_class] = self._deque()
+        q.append(ptask)
+        self._count += 1
+
+    def class_queues(self):
+        """Live (class, deque) pairs; empty classes are pruned."""
+        dead = [c for c, q in self._classes.items() if not q]
+        for c in dead:
+            del self._classes[c]
+        return list(self._classes.items())
+
+    def popleft_from(self, q) -> PendingTask:
+        ptask = q.popleft()
+        self._count -= 1
+        return ptask
+
+    def requeue_front(self, ptask: PendingTask):
+        q = self._classes.get(ptask.sched_class)
+        if q is None:
+            self.append(ptask)
+            return
+        q.appendleft(ptask)
+        self._count += 1
+
+    def remove(self, ptask: PendingTask) -> bool:
+        q = self._classes.get(ptask.sched_class)
+        if q is None:
+            return False
+        try:
+            q.remove(ptask)
+        except ValueError:
+            return False
+        self._count -= 1
+        return True
+
+    def __iter__(self):
+        for q in self._classes.values():
+            yield from q
+
+    def __len__(self):
+        return self._count
 
 
 class Raylet:
@@ -267,7 +341,9 @@ class Raylet:
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}  # keyed by env hash
-        self.pending: List[PendingTask] = []
+        self.pending = PendingQueue()
+        self._spilling_classes: set = set()
+        self._peer_raylets: Dict[str, Any] = {}
         self.gcs: Optional[protocol.Connection] = None
         self.server = protocol.Server(self._handlers())
         self.address = ""
@@ -287,6 +363,7 @@ class Raylet:
     def _handlers(self):
         return {
             "submit_task": self.handle_submit_task,
+            "submit_task_batch": self.handle_submit_task_batch,
             "task_done": self.handle_task_done,
             "worker_register": self.handle_worker_register,
             "create_actor_worker": self.handle_create_actor_worker,
@@ -702,10 +779,26 @@ class Raylet:
                 return True
         return False
 
+    @staticmethod
+    def _policy_routed(spec) -> bool:
+        """Tasks with an explicit placement policy (SPREAD, node
+        affinity, TPU topology) route through the GCS scheduler on
+        arrival instead of soaking into the local queue — a feasible
+        local node must not defeat SPREAD (reference: lease_policy.cc,
+        the owner consults the scheduler before leasing)."""
+        sched = spec.get("scheduling") or {}
+        return bool(sched.get("spread") or sched.get("node_id")
+                    or sched.get("tpu_topology"))
+
     async def handle_submit_task(self, payload, conn):
         fut = asyncio.get_running_loop().create_future()
         ptask = PendingTask(payload, fut)
-        if self._infeasible(ptask) or payload.get("spilled_from"):
+        if not payload.get("spilled_from") and \
+                (self._infeasible(ptask) or self._policy_routed(payload)):
+            spill = await self._try_spillback(ptask, force=True)
+            if spill is not None:
+                return spill
+        elif payload.get("spilled_from"):
             spill = await self._try_spillback(ptask,
                                               force=self._infeasible(ptask))
             if spill is not None:
@@ -713,6 +806,58 @@ class Raylet:
         self.pending.append(ptask)
         self._dispatch_event.set()
         return await fut
+
+    async def handle_submit_task_batch(self, payload, conn):
+        """Batched submission (the >=10k tasks/s path; reference gets its
+        throughput the same way — one RPC carrying many TaskSpecs). The
+        reply is an immediate ack; dispatch-time failures flow back as
+        `task_dispatch_status` notifies on the submitting connection so
+        the owner's retry machinery sees the same error vocabulary as the
+        unary path."""
+        loop = asyncio.get_running_loop()
+        accepted = 0
+        for spec in payload["specs"]:
+            fut = loop.create_future()
+            ptask = PendingTask(spec, fut)
+
+            def _on_done(f, task_id=spec["task_id"]):
+                try:
+                    reply = f.result()
+                except Exception as e:  # noqa: BLE001 — crosses the wire
+                    reply = {"error": "INTERNAL", "message": str(e)}
+
+                # every dispatch outcome is notified — success carries
+                # worker_address so the owner can tell "dispatched"
+                # from "still queued" when this connection dies
+                async def _notify():
+                    try:
+                        await conn.notify("task_dispatch_status",
+                                          {"task_id": task_id, **reply})
+                    except Exception:
+                        pass  # owner-side on_close handles a dead conn
+                loop.create_task(_notify())
+
+            fut.add_done_callback(_on_done)
+            if self._infeasible(ptask) or spec.get("spilled_from") or \
+                    self._policy_routed(spec):
+                # rare path: resolve off-line so the batch ack stays fast
+                async def _spill(pt=ptask):
+                    force = self._infeasible(pt) or (
+                        self._policy_routed(pt.spec)
+                        and not pt.spec.get("spilled_from"))
+                    spill = await self._try_spillback(pt, force=force)
+                    if spill is not None:
+                        if not pt.reply_fut.done():
+                            pt.reply_fut.set_result(spill)
+                        return
+                    self.pending.append(pt)
+                    self._dispatch_event.set()
+                loop.create_task(_spill())
+            else:
+                self.pending.append(ptask)
+            accepted += 1
+        self._dispatch_event.set()
+        return {"accepted": accepted}
 
     async def _try_spillback(self, ptask: PendingTask, force: bool):
         """Ask GCS for another node (reference: spillback in
@@ -736,47 +881,91 @@ class Raylet:
         spec = dict(ptask.spec)
         spec["spilled_from"] = self.node_id
         try:
-            remote = await protocol.connect(r["raylet_address"])
-            try:
-                return await remote.call("submit_task", spec)
-            finally:
-                remote.close()
+            remote = await self._raylet_peer(r["raylet_address"])
+            return await remote.call("submit_task", spec)
         except Exception:
             return None
 
+    async def _raylet_peer(self, address: str) -> "protocol.Connection":
+        """Cached connection to a peer raylet (spillback reuses it; a
+        fresh dial per spilled task would dominate a backlog drain)."""
+        conn = self._peer_raylets.get(address)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = await protocol.connect(address)
+        self._peer_raylets[address] = conn
+        return conn
+
     async def _dispatch_loop(self):
         """The hot dispatch loop (reference:
-        local_task_manager.cc:99 DispatchScheduledTasksToWorkers)."""
+        local_task_manager.cc:99 DispatchScheduledTasksToWorkers).
+
+        Visits the HEAD of each scheduling class only: tasks in a class
+        are interchangeable for feasibility, so a blocked head blocks the
+        whole class and the rest need not be scanned. No awaits between
+        the feasibility check and the resource take, so two pending tasks
+        can never both be judged feasible against the same availability
+        and then over-subscribe (spillback probes run as side tasks)."""
         while not self._shutdown:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
-            i = 0
-            while i < len(self.pending):
-                ptask = self.pending[i]
-                if not self._resources_feasible(ptask):
-                    # try spillback for plain tasks stuck too long
-                    if time.monotonic() - ptask.submitted_at > 1.0 and \
-                            not ptask.spec.get("spilled_from") and \
-                            not ptask.spec.get("placement_group"):
-                        reply = await self._try_spillback(ptask, force=False)
-                        if reply is not None:
-                            self.pending.pop(i)
-                            if not ptask.reply_fut.done():
-                                ptask.reply_fut.set_result(reply)
-                            continue
-                    i += 1
-                    continue
-                # Acquire synchronously (no await between the feasibility
-                # check and the take) so two pending tasks can never both be
-                # judged feasible against the same availability and then
-                # over-subscribe when their dispatch coroutines run.
-                chips = self._acquire_resources(ptask)
-                if chips is None:
-                    i += 1
-                    continue
-                self.pending.pop(i)
-                asyncio.get_running_loop().create_task(
-                    self._dispatch(ptask, chips))
+            now = time.monotonic()
+            for cls, q in self.pending.class_queues():
+                while q:
+                    ptask = q[0]
+                    if not self._resources_feasible(ptask):
+                        # try spillback for plain tasks stuck too long
+                        if now - ptask.submitted_at > 1.0 and \
+                                cls not in self._spilling_classes and \
+                                not ptask.spec.get("spilled_from") and \
+                                not ptask.spec.get("placement_group"):
+                            self._spilling_classes.add(cls)
+                            asyncio.get_running_loop().create_task(
+                                self._spillback_class(cls))
+                        break
+                    chips = self._acquire_resources(ptask)
+                    if chips is None:
+                        break
+                    self.pending.popleft_from(q)
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(ptask, chips))
+
+    async def _spillback_class(self, cls):
+        """Drain a stuck scheduling class to other nodes: keep asking the
+        GCS for placements (its pessimistic in-flight accounting
+        round-robins a burst across the cluster) and moving queued tasks
+        out while the local node stays saturated. Each task is POPPED
+        before its remote submit (no double-dispatch; the dispatch loop
+        keeps running the class with the remaining tasks, so local
+        capacity freeing up mid-drain is used immediately) and re-queued
+        if the move fails. One drainer per class at a time."""
+        try:
+            while not self._shutdown:
+                q = self.pending._classes.get(cls)
+                if not q:
+                    return
+                head = q[0]
+                if self._resources_feasible(head) or \
+                        head.spec.get("spilled_from") or \
+                        head.spec.get("placement_group"):
+                    return
+                self.pending.popleft_from(q)
+                try:
+                    reply = await self._try_spillback(head, force=False)
+                except Exception:
+                    reply = None
+                if reply is None:
+                    # nowhere to go: requeue at the front, re-arm the
+                    # stuck timer so the probe isn't hot
+                    head.submitted_at = time.monotonic()
+                    self.pending.requeue_front(head)
+                    return
+                if head.reply_fut is not None and \
+                        not head.reply_fut.done():
+                    head.reply_fut.set_result(reply)
+        finally:
+            self._spilling_classes.discard(cls)
+            self._dispatch_event.set()
 
     async def _dispatch(self, ptask: PendingTask, chips: Tuple[int, ...]):
         env_hash = _env_hash(ptask.spec.get("runtime_env") or {})
@@ -854,9 +1043,9 @@ class Raylet:
 
     async def handle_cancel_task(self, payload, conn):
         task_id = payload["task_id"]
-        for i, pt in enumerate(self.pending):
+        for pt in self.pending:
             if pt.spec["task_id"] == task_id:
-                self.pending.pop(i)
+                self.pending.remove(pt)
                 if not pt.reply_fut.done():
                     pt.reply_fut.set_result({"error": "CANCELLED"})
                 return {"cancelled": "queued"}
@@ -992,16 +1181,16 @@ class Raylet:
                     self.available.get("TPU", 0) + len(returned)
         # tasks still queued against this PG can never run now — fail them
         pg_id = payload["pg_id"]
-        for i in range(len(self.pending) - 1, -1, -1):
-            pt = self.pending[i]
-            pg = pt.spec.get("placement_group")
-            if pg and pg.get("pg_id") == pg_id:
-                self.pending.pop(i)
-                if pt.reply_fut is not None and not pt.reply_fut.done():
-                    pt.reply_fut.set_result({
-                        "error": "PLACEMENT_GROUP_REMOVED",
-                        "message": f"placement group {pg_id} was removed",
-                    })
+        doomed = [pt for pt in self.pending
+                  if (pt.spec.get("placement_group") or {}).get("pg_id")
+                  == pg_id]
+        for pt in doomed:
+            self.pending.remove(pt)
+            if pt.reply_fut is not None and not pt.reply_fut.done():
+                pt.reply_fut.set_result({
+                    "error": "PLACEMENT_GROUP_REMOVED",
+                    "message": f"placement group {pg_id} was removed",
+                })
         self._dispatch_event.set()
         return {"ok": True}
 

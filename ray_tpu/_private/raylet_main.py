@@ -6,8 +6,13 @@ import logging
 import os
 import signal
 
+from ray_tpu._private.node import restore_tpu_plugin_env
 from ray_tpu._private.raylet import Raylet
 from ray_tpu.common.config import SystemConfig
+
+# this process skipped the TPU-plugin sitecustomize; worker children
+# must still see the tunnel env (see node._defer_tpu_plugin)
+restore_tpu_plugin_env()
 
 
 async def main():

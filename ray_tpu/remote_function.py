@@ -35,6 +35,15 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_opts)
 
+    def remote_batch(self, arg_tuples):
+        """Submit many invocations in one go: ``arg_tuples`` is an
+        iterable of positional-arg tuples; returns a list of refs (or
+        ref-lists when num_returns > 1). Amortizes per-call overhead —
+        the >=10k tasks/s submission path (reference analogue: the
+        batched submission the reference's scalability envelope relies
+        on, release/benchmarks/README.md)."""
+        return self._remote_batch(arg_tuples, self._default_opts)
+
     def bind(self, *args, **kwargs):
         """DAG authoring (reference: python/ray/dag FunctionNode)."""
         from ray_tpu.dag import FunctionNode
@@ -58,6 +67,18 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def _remote_batch(self, arg_tuples, opts: Dict[str, Any]):
+        w = global_worker()
+        if self._fn_key is None or self._fn_key_mgr is not w.function_manager:
+            self._fn_key = w.function_manager.export(self._fn, kind="fn")
+            self._fn_key_mgr = w.function_manager
+        ref_lists = w.submit_task_batch(self._fn_key, self._fn.__name__,
+                                        arg_tuples, opts)
+        num_returns = opts.get("num_returns")
+        if num_returns is None or num_returns == 1:
+            return [refs[0] for refs in ref_lists]
+        return ref_lists
+
 
 class _BoundRemoteFunction:
     def __init__(self, remote_fn: RemoteFunction, opts: Dict[str, Any]):
@@ -66,6 +87,9 @@ class _BoundRemoteFunction:
 
     def remote(self, *args, **kwargs):
         return self._remote_fn._remote(args, kwargs, self._opts)
+
+    def remote_batch(self, arg_tuples):
+        return self._remote_fn._remote_batch(arg_tuples, self._opts)
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import FunctionNode

@@ -24,6 +24,11 @@ from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
                                              BanditLinTSConfig,
                                              BanditLinUCB,
                                              BanditLinUCBConfig)
+from ray_tpu.rllib.algorithms.alpha_zero import (AlphaZero,
+                                                 AlphaZeroConfig)
+from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
+from ray_tpu.rllib.algorithms.maml import MAML, MAMLConfig
+from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig
 
 __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "DQNConfig", "SimpleQ", "SimpleQConfig", "ApexDQN",
@@ -38,4 +43,6 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "BanditLinUCB", "BanditLinUCBConfig",
            "BanditLinTS", "BanditLinTSConfig",
            "QMix", "QMixConfig", "R2D2", "R2D2Config", "DT", "DTConfig",
-           "MADDPG", "MADDPGConfig"]
+           "MADDPG", "MADDPGConfig",
+           "AlphaZero", "AlphaZeroConfig", "Dreamer", "DreamerConfig",
+           "MAML", "MAMLConfig", "SlateQ", "SlateQConfig"]

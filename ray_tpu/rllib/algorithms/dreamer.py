@@ -1,0 +1,474 @@
+"""Dreamer (V1) — learned world model + actor-critic in imagination.
+
+Reference analogue: rllib/algorithms/dreamer/ (dreamer.py,
+dreamer_torch_policy.py, dreamer_model.py; Hafner et al. 2020): an RSSM
+world model (deterministic GRU path + stochastic latent) trained on
+replayed sequences by reconstruction + reward prediction + KL, and an
+actor/value pair trained ENTIRELY on imagined latent rollouts with
+lambda-returns, the actor by backprop THROUGH the learned dynamics
+(reparameterized latents — no likelihood-ratio estimator). TPU-first
+shape: all three updates are single jitted programs over [B, T, ...]
+sequence batches; imagination is a lax.scan over the horizon.
+
+Vector-observation variant (MLP encoder/decoder) — the reference's
+conv stack only changes the encoder/decoder modules.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.env import make_env
+
+
+class _RSSM(nn.Module):
+    """h_t = GRU(h_{t-1}, [z_{t-1}, a_{t-1}]); prior p(z|h); posterior
+    q(z|h, embed(obs))."""
+    deter: int = 64
+    stoch: int = 8
+    hidden: int = 64
+
+    def setup(self):
+        self.gru = nn.GRUCell(features=self.deter)
+        self.inp = nn.Dense(self.hidden)
+        self.prior_net = nn.Dense(2 * self.stoch)
+        self.post_net = nn.Dense(2 * self.stoch)
+
+    def _stats(self, net, x):
+        mean, std = jnp.split(net(x), 2, axis=-1)
+        return mean, nn.softplus(std) + 0.1
+
+    def step_prior(self, h, z, a):
+        x = nn.relu(self.inp(jnp.concatenate([z, a], -1)))
+        h, _ = self.gru(h, x)
+        mean, std = self._stats(self.prior_net, h)
+        return h, mean, std
+
+    def posterior(self, h, embed):
+        return self._stats(self.post_net,
+                           jnp.concatenate([h, embed], -1))
+
+
+class _WorldModel(nn.Module):
+    obs_dim: int
+    act_dim: int
+    deter: int = 64
+    stoch: int = 8
+    hidden: int = 64
+
+    def setup(self):
+        self.rssm = _RSSM(self.deter, self.stoch, self.hidden)
+        self.encoder = nn.Sequential([nn.Dense(self.hidden), nn.relu,
+                                      nn.Dense(self.hidden)])
+        self.decoder = nn.Sequential([nn.Dense(self.hidden), nn.relu,
+                                      nn.Dense(self.obs_dim)])
+        self.reward_head = nn.Sequential([nn.Dense(self.hidden), nn.relu,
+                                          nn.Dense(1)])
+
+    def observe(self, obs_seq, act_seq, rng):
+        """obs_seq [B,T,do], act_seq [B,T,da] (act at t-1; zeros at 0).
+        Returns posterior features [B,T,deter+stoch] + KL terms.
+        The T loop is a Python unroll (tiny seq_len; XLA fuses the GRU
+        chain) — keeps submodule calls linen-legal without nn.scan."""
+        b, t, _ = obs_seq.shape
+        embed = self.encoder(obs_seq)
+        h = jnp.zeros((b, self.deter))
+        z = jnp.zeros((b, self.stoch))
+        feats, kls = [], []
+        key = rng
+        for i in range(t):
+            h, p_mean, p_std = self.rssm.step_prior(h, z, act_seq[:, i])
+            q_mean, q_std = self.rssm.posterior(h, embed[:, i])
+            key, sub = jax.random.split(key)
+            z = q_mean + q_std * jax.random.normal(sub, q_mean.shape)
+            kls.append(self._kl(q_mean, q_std, p_mean, p_std))
+            feats.append(jnp.concatenate([h, z], -1))
+        return jnp.stack(feats, 1), jnp.stack(kls, 1)
+
+    @staticmethod
+    def _kl(qm, qs, pm, ps):
+        return jnp.sum(
+            jnp.log(ps / qs) + (qs ** 2 + (qm - pm) ** 2)
+            / (2 * ps ** 2) - 0.5, axis=-1)
+
+    def decode(self, feat):
+        return self.decoder(feat)
+
+    def reward(self, feat):
+        return self.reward_head(feat)[..., 0]
+
+    def imagine_step(self, h, z, a, key):
+        h, mean, std = self.rssm.step_prior(h, z, a)
+        z = mean + std * jax.random.normal(key, mean.shape)
+        return h, z
+
+    def init_all(self, obs_seq, act_seq, rng):
+        """Touches every head so ``init`` creates the full param tree."""
+        feat, _ = self.observe(obs_seq, act_seq, rng)
+        return self.decode(feat), self.reward(feat)
+
+
+class _Actor(nn.Module):
+    act_dim: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, feat):
+        x = nn.relu(nn.Dense(self.hidden)(feat))
+        mean = nn.Dense(self.act_dim)(x)
+        logstd = self.param("logstd", nn.initializers.constant(-1.0),
+                            (self.act_dim,))
+        return jnp.tanh(mean), jnp.exp(logstd)
+
+
+class _Value(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, feat):
+        x = nn.relu(nn.Dense(self.hidden)(feat))
+        return nn.Dense(1)(x)[..., 0]
+
+
+class DreamerConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or Dreamer)
+        self._config.update({
+            "env": "Pendulum-v1",
+            "deter_size": 64,
+            "stoch_size": 8,
+            "hidden": 64,
+            "model_lr": 3e-3,
+            "actor_lr": 1e-3,
+            "value_lr": 3e-3,
+            "gamma": 0.97,
+            "lambda_": 0.95,
+            "imagine_horizon": 10,
+            "kl_coeff": 0.3,
+            "free_nats": 1.0,
+            "batch_size": 24,     # sequences per model update
+            "seq_len": 16,
+            "prefill_steps": 1_000,
+            "rollout_fragment_length": 200,
+            "train_steps_per_iteration": 20,
+            "expl_noise": 0.3,
+        })
+
+
+class Dreamer(LocalAlgorithm):
+    """DreamerV1 (reference: dreamer.py training loop — collect,
+    then model/actor/value updates on replayed sequences)."""
+
+    _default_config_cls = DreamerConfig
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        self.env = make_env(cfg["env"], cfg.get("env_config"))
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.act_dim = int(np.prod(self.env.action_space.shape))
+        self.act_low = np.asarray(self.env.action_space.low, np.float32)
+        self.act_high = np.asarray(self.env.action_space.high, np.float32)
+
+        self.wm = _WorldModel(self.obs_dim, self.act_dim,
+                              cfg["deter_size"], cfg["stoch_size"],
+                              cfg["hidden"])
+        self.actor = _Actor(self.act_dim, cfg["hidden"])
+        self.value = _Value(cfg["hidden"])
+
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        k1, k2, k3, k4 = jax.random.split(self._rng, 4)
+        dummy_obs = jnp.zeros((1, 2, self.obs_dim))
+        dummy_act = jnp.zeros((1, 2, self.act_dim))
+        self.wm_params = self.wm.init(
+            {"params": k1}, dummy_obs, dummy_act, k2,
+            method=_WorldModel.init_all)["params"]
+        feat_dim = cfg["deter_size"] + cfg["stoch_size"]
+        self.actor_params = self.actor.init(
+            k3, jnp.zeros((1, feat_dim)))["params"]
+        self.value_params = self.value.init(
+            k4, jnp.zeros((1, feat_dim)))["params"]
+
+        self.wm_opt = optax.adam(cfg["model_lr"])
+        self.actor_opt = optax.adam(cfg["actor_lr"])
+        self.value_opt = optax.adam(cfg["value_lr"])
+        self.wm_opt_state = self.wm_opt.init(self.wm_params)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.value_opt_state = self.value_opt.init(self.value_params)
+
+        # LocalAlgorithm checkpoint plumbing
+        self.params = {"wm": self.wm_params, "actor": self.actor_params,
+                       "value": self.value_params}
+        self.target_params = self.params
+        self.opt_state = (self.wm_opt_state, self.actor_opt_state,
+                          self.value_opt_state)
+
+        self._jit_update = jax.jit(self._update_impl)
+        self._jit_filter = jax.jit(self._filter_impl)
+
+        # episode replay: list of dicts of np arrays
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        self._init_local_state()
+        self._reset_collector()
+
+    # ---- acting (posterior filtering) ----
+
+    def _reset_collector(self):
+        self._obs, _ = self.env.reset(seed=self.config.get("seed"))
+        self._h = jnp.zeros((1, self.config["deter_size"]))
+        self._z = jnp.zeros((1, self.config["stoch_size"]))
+        self._prev_a = jnp.zeros((1, self.act_dim))
+        self._ep = {"obs": [], "actions": [], "rewards": []}
+        self._episode_reward = 0.0
+
+    def _filter_impl(self, wm_params, actor_params, h, z, prev_a, obs,
+                     key):
+        """One posterior-filter step + action."""
+        embed = self.wm.apply({"params": wm_params}, obs,
+                              method=lambda m, o: m.encoder(o))
+        h, _pm, _ps = self.wm.apply(
+            {"params": wm_params}, h, z, prev_a,
+            method=lambda m, h_, z_, a_: m.rssm.step_prior(h_, z_, a_))
+        q_mean, q_std = self.wm.apply(
+            {"params": wm_params}, h, embed,
+            method=lambda m, h_, e_: m.rssm.posterior(h_, e_))
+        k1, k2 = jax.random.split(key)
+        z = q_mean + q_std * jax.random.normal(k1, q_mean.shape)
+        feat = jnp.concatenate([h, z], -1)
+        mean, std = self.actor.apply({"params": actor_params}, feat)
+        a = mean + std * jax.random.normal(k2, mean.shape)
+        return h, z, a
+
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _env_action(self, a: np.ndarray, noise: float) -> np.ndarray:
+        a = a + noise * self._np_rng.standard_normal(a.shape)
+        half = (self.act_high - self.act_low) / 2.0
+        mid = (self.act_high + self.act_low) / 2.0
+        return np.clip(mid + half * a, self.act_low, self.act_high)
+
+    def _collect(self, num_steps: int, noise: float) -> int:
+        for _ in range(num_steps):
+            self._h, self._z, a = self._jit_filter(
+                self.wm_params, self.actor_params, self._h, self._z,
+                self._prev_a, jnp.asarray(self._obs[None], jnp.float32),
+                self._next_key())
+            a_np = np.asarray(a)[0]
+            env_a = self._env_action(a_np, noise)
+            nobs, r, term, trunc, _ = self.env.step(env_a)
+            self._ep["obs"].append(np.asarray(self._obs, np.float32))
+            self._ep["actions"].append(np.asarray(a_np, np.float32))
+            self._ep["rewards"].append(np.float32(r))
+            self._prev_a = a
+            self._episode_reward += float(r)
+            if term or trunc:
+                self._episodes.append(
+                    {k: np.stack(v) for k, v in self._ep.items()})
+                self._episodes = self._episodes[-200:]
+                self._episode_reward_window.append(self._episode_reward)
+                self._reset_collector()
+            else:
+                self._obs = nobs
+        return num_steps
+
+    # ---- jitted three-headed update ----
+
+    def _sample_sequences(self) -> Optional[Dict[str, jnp.ndarray]]:
+        cfg = self.config
+        T = cfg["seq_len"]
+        eligible = [e for e in self._episodes
+                    if e["obs"].shape[0] >= T]
+        if not eligible:
+            return None
+        obs_b, act_b, rew_b = [], [], []
+        for _ in range(cfg["batch_size"]):
+            ep = eligible[self._np_rng.integers(len(eligible))]
+            start = self._np_rng.integers(0, ep["obs"].shape[0] - T + 1)
+            obs_b.append(ep["obs"][start:start + T])
+            # action at index t is a_{t-1} (zeros at episode start)
+            acts = ep["actions"][start:start + T]
+            prev = np.concatenate(
+                [np.zeros((1, self.act_dim), np.float32)
+                 if start == 0 else
+                 ep["actions"][start - 1:start], acts[:-1]])
+            act_b.append(prev)
+            rew_b.append(ep["rewards"][start:start + T])
+        return {"obs": jnp.asarray(np.stack(obs_b)),
+                "prev_actions": jnp.asarray(np.stack(act_b)),
+                "rewards": jnp.asarray(np.stack(rew_b))}
+
+    def _update_impl(self, wm_params, actor_params, value_params,
+                     wm_os, actor_os, value_os, batch, key):
+        cfg = self.config
+        k_model, k_imagine = jax.random.split(key)
+
+        # --- world model ---
+        def wm_loss_fn(p):
+            feat, kls = self.wm.apply(
+                {"params": p}, batch["obs"], batch["prev_actions"],
+                k_model, method=_WorldModel.observe)
+            recon = self.wm.apply({"params": p}, feat,
+                                  method=_WorldModel.decode)
+            rhat = self.wm.apply({"params": p}, feat,
+                                 method=_WorldModel.reward)
+            recon_l = jnp.mean(jnp.sum(
+                (recon - batch["obs"]) ** 2, -1))
+            reward_l = jnp.mean((rhat - batch["rewards"]) ** 2)
+            kl = jnp.mean(jnp.maximum(kls, cfg["free_nats"]))
+            return (recon_l + reward_l + cfg["kl_coeff"] * kl,
+                    (feat, recon_l, reward_l, kl))
+
+        (wm_l, (feat, recon_l, reward_l, kl)), wm_grads = \
+            jax.value_and_grad(wm_loss_fn, has_aux=True)(wm_params)
+        upd, wm_os = self.wm_opt.update(wm_grads, wm_os, wm_params)
+        wm_params = optax.apply_updates(wm_params, upd)
+
+        # --- imagination from (stop-gradient) posterior states ---
+        feat = jax.lax.stop_gradient(feat.reshape(-1, feat.shape[-1]))
+        h0 = feat[:, :cfg["deter_size"]]
+        z0 = feat[:, cfg["deter_size"]:]
+
+        def imagine(actor_p, h, z, key):
+            def step(carry, k):
+                h, z = carry
+                f = jnp.concatenate([h, z], -1)
+                mean, std = self.actor.apply({"params": actor_p}, f)
+                k1, k2 = jax.random.split(k)
+                a = mean + std * jax.random.normal(k1, mean.shape)
+                h, z = self.wm.apply(
+                    {"params": wm_params}, h, z, a, k2,
+                    method=lambda m, h_, z_, a_, kk: m.imagine_step(
+                        h_, z_, a_, kk))
+                return (h, z), jnp.concatenate([h, z], -1)
+
+            keys = jax.random.split(key, cfg["imagine_horizon"])
+            (_, _), feats = jax.lax.scan(step, (h, z), keys)
+            return feats  # [H, B, feat]
+
+        def actor_loss_fn(actor_p):
+            feats = imagine(actor_p, h0, z0, k_imagine)
+            rewards = self.wm.apply({"params": wm_params}, feats,
+                                    method=_WorldModel.reward)
+            values = self.value.apply({"params": value_params}, feats)
+            # lambda-returns computed backwards (Hafner eq. 6)
+            gamma, lam = cfg["gamma"], cfg["lambda_"]
+
+            def lam_step(nxt, rv):
+                r, v_next = rv
+                ret = r + gamma * ((1 - lam) * v_next + lam * nxt)
+                return ret, ret
+
+            last = values[-1]
+            _, rets = jax.lax.scan(
+                lam_step, last,
+                (rewards[:-1][::-1], values[1:][::-1]))
+            returns = rets[::-1]  # [H-1, B]
+            return -jnp.mean(returns), (feats, returns)
+
+        (actor_l, (feats, returns)), actor_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(actor_params)
+        upd, actor_os = self.actor_opt.update(actor_grads, actor_os,
+                                              actor_params)
+        actor_params = optax.apply_updates(actor_params, upd)
+
+        # --- value regression on the imagined lambda-returns ---
+        feats_sg = jax.lax.stop_gradient(feats[:-1])
+        returns_sg = jax.lax.stop_gradient(returns)
+
+        def value_loss_fn(vp):
+            v = self.value.apply({"params": vp}, feats_sg)
+            return jnp.mean((v - returns_sg) ** 2)
+
+        value_l, value_grads = jax.value_and_grad(value_loss_fn)(
+            value_params)
+        upd, value_os = self.value_opt.update(value_grads, value_os,
+                                              value_params)
+        value_params = optax.apply_updates(value_params, upd)
+
+        stats = {"model_loss": wm_l, "recon_loss": recon_l,
+                 "reward_loss": reward_l, "kl": kl,
+                 "actor_loss": actor_l, "value_loss": value_l}
+        return (wm_params, actor_params, value_params,
+                wm_os, actor_os, value_os, stats)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        if self._timesteps_total < cfg["prefill_steps"]:
+            n = self._collect(cfg["prefill_steps"], noise=1.0)
+        else:
+            n = self._collect(cfg["rollout_fragment_length"],
+                              noise=cfg["expl_noise"])
+        self._timesteps_total += n
+        stats: Dict[str, float] = {}
+        for _ in range(cfg["train_steps_per_iteration"]):
+            batch = self._sample_sequences()
+            if batch is None:
+                break
+            (self.wm_params, self.actor_params, self.value_params,
+             self.wm_opt_state, self.actor_opt_state,
+             self.value_opt_state, jstats) = self._jit_update(
+                self.wm_params, self.actor_params, self.value_params,
+                self.wm_opt_state, self.actor_opt_state,
+                self.value_opt_state, batch, self._next_key())
+            stats = {k: float(v) for k, v in jstats.items()}
+        self.params = {"wm": self.wm_params, "actor": self.actor_params,
+                       "value": self.value_params}
+        self.opt_state = (self.wm_opt_state, self.actor_opt_state,
+                          self.value_opt_state)
+        return {
+            "num_env_steps_sampled_this_iter": n,
+            "num_episodes": len(self._episodes),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=20_000 + ep)
+            h = jnp.zeros((1, self.config["deter_size"]))
+            z = jnp.zeros((1, self.config["stoch_size"]))
+            prev_a = jnp.zeros((1, self.act_dim))
+            total, done = 0.0, False
+            while not done:
+                h, z, a = self._jit_filter(
+                    self.wm_params, self.actor_params, h, z, prev_a,
+                    jnp.asarray(obs[None], jnp.float32),
+                    self._next_key())
+                env_a = self._env_action(np.asarray(a)[0], 0.0)
+                obs, r, term, trunc, _ = self.env.step(env_a)
+                prev_a = a
+                total += float(r)
+                done = term or trunc
+            rewards.append(total)
+        # collector state untouched: eval used its own h/z stream
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+        }}
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "target_params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self._iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        super().load_checkpoint(state)
+        self.wm_params = self.params["wm"]
+        self.actor_params = self.params["actor"]
+        self.value_params = self.params["value"]
+        (self.wm_opt_state, self.actor_opt_state,
+         self.value_opt_state) = self.opt_state

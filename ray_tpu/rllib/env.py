@@ -105,6 +105,10 @@ class PendulumEnv:
         self.observation_space = Box(-np.inf, np.inf, (3,))
         self.action_space = Box(-self.MAX_TORQUE, self.MAX_TORQUE, (1,))
         self._rng = np.random.default_rng(config.get("seed"))
+        # balance mode: start near upright — the short-credit-horizon
+        # variant (swing-up needs long-horizon planning; balancing is
+        # the standard quick target for model-based smoke tests)
+        self._balance = bool(config.get("balance_init"))
         self._th = self._thdot = 0.0
         self._t = 0
 
@@ -115,8 +119,12 @@ class PendulumEnv:
     def reset(self, *, seed: Optional[int] = None):
         if seed is not None:
             self._rng = np.random.default_rng(seed)
-        self._th = self._rng.uniform(-np.pi, np.pi)
-        self._thdot = self._rng.uniform(-1.0, 1.0)
+        if self._balance:
+            self._th = self._rng.uniform(-0.3, 0.3)
+            self._thdot = self._rng.uniform(-0.2, 0.2)
+        else:
+            self._th = self._rng.uniform(-np.pi, np.pi)
+            self._thdot = self._rng.uniform(-1.0, 1.0)
         self._t = 0
         return self._obs(), {}
 
@@ -134,9 +142,77 @@ class PendulumEnv:
         return self._obs(), -cost, False, self._t >= self.MAX_STEPS, {}
 
 
+class PixelCatcher:
+    """Procedurally generated Atari-class pixel env (ALE is not
+    installable in this image; reference analogue: the pixel envs the
+    reference's release tests run through atari_wrappers.py). An
+    84x84x1 uint8 screen: a 4x4 ball falls from a random column; a
+    12px paddle at the bottom moves left/stay/right by 6px. +1 for a
+    catch, -1 for a miss; episode = ``drops`` balls. Exercises the full
+    image path: CNN policy, grayscale/resize/frame-stack connectors."""
+
+    SIZE = 84
+    BALL = 4
+    PADDLE_W = 12
+    PADDLE_H = 4
+    STEP_X = 6
+    FALL = 6
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        cfg = config or {}
+        self.drops = int(cfg.get("drops", 4))
+        self.observation_space = Box(0, 255, (self.SIZE, self.SIZE, 1),
+                                     np.uint8)
+        self.action_space = Discrete(3)
+        self._rng = np.random.default_rng(cfg.get("seed"))
+        self._ball = [0, 0]
+        self._paddle_x = 0
+        self._drops_left = 0
+
+    def _spawn(self):
+        self._ball = [0, int(self._rng.integers(
+            0, self.SIZE - self.BALL))]
+
+    def _obs(self) -> np.ndarray:
+        img = np.zeros((self.SIZE, self.SIZE, 1), np.uint8)
+        y, x = self._ball
+        img[y:y + self.BALL, x:x + self.BALL, 0] = 255
+        img[self.SIZE - self.PADDLE_H:,
+            self._paddle_x:self._paddle_x + self.PADDLE_W, 0] = 160
+        return img
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._paddle_x = (self.SIZE - self.PADDLE_W) // 2
+        self._drops_left = self.drops
+        self._spawn()
+        return self._obs(), {}
+
+    def step(self, action):
+        a = int(action)
+        self._paddle_x = int(np.clip(
+            self._paddle_x + (a - 1) * self.STEP_X,
+            0, self.SIZE - self.PADDLE_W))
+        self._ball[0] += self.FALL
+        reward, term = 0.0, False
+        if self._ball[0] + self.BALL >= self.SIZE - self.PADDLE_H:
+            bx = self._ball[1]
+            caught = (bx + self.BALL > self._paddle_x and
+                      bx < self._paddle_x + self.PADDLE_W)
+            reward = 1.0 if caught else -1.0
+            self._drops_left -= 1
+            if self._drops_left <= 0:
+                term = True
+            else:
+                self._spawn()
+        return self._obs(), reward, term, False, {}
+
+
 _BUILTIN_ENVS = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
+    "PixelCatcher-v0": PixelCatcher,
 }
 # MultiAgentCartPole is appended below (class defined after make_env)
 

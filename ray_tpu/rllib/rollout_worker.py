@@ -29,14 +29,17 @@ class RolloutWorker:
         self.vector_env = VectorEnv(
             env_fn, config.get("num_envs_per_worker", 1),
             seed=(config.get("seed") or 0) * 10_000 + worker_index)
-        self.policy = policy_cls(
-            self.vector_env.observation_space,
-            self.vector_env.action_space, config)
         # connector pipelines: obs transforms before the policy forward,
-        # action transforms before env.step (rllib/connectors.py)
+        # action transforms before env.step (rllib/connectors.py). The
+        # policy sees the PIPELINE's output space (frame stacking /
+        # resizing change shapes), not the raw env space.
         from ray_tpu.rllib.connectors import build_connectors
         self.obs_connectors, self.action_connectors = \
             build_connectors(config)
+        self.policy = policy_cls(
+            self.obs_connectors.observation_space(
+                self.vector_env.observation_space),
+            self.vector_env.action_space, config)
         self._obs = self.vector_env.reset_all()
         # processed view of _obs, cached so stateful connectors (MeanStd)
         # see each observation exactly once
@@ -72,14 +75,18 @@ class RolloutWorker:
             for i, info in enumerate(infos):
                 if "terminal_observation" in info:
                     true_next[i] = info["terminal_observation"]
-            proc_next = self.obs_connectors(next_obs)
             if has_obs_conn:
-                # the TRUE next obs (incl. terminal_observation rows, which
-                # truncated-episode bootstrapping reads) goes through a
-                # state-preserving transform — already-counted rows must
-                # not enter the running stats twice
+                # ORDER MATTERS: the TRUE next obs (incl.
+                # terminal_observation rows, which truncated-episode
+                # bootstrapping reads) goes through a state-preserving
+                # transform against the PRE-step connector state (frame
+                # stacks must not have restarted yet, running stats
+                # must not count rows twice) — only then does the
+                # stateful pass advance, restarting auto-reset slots
                 true_next = np.asarray(
                     self.obs_connectors.transform(true_next))
+            proc_next = self.obs_connectors(next_obs,
+                                            dones=terms | truncs)
             # the batch records the PROCESSED obs (what the policy saw)
             # and the RAW actions (what logp corresponds to)
             cols[SampleBatch.OBS].append(np.asarray(proc_obs).copy())
@@ -129,11 +136,13 @@ class RolloutWorker:
         rewards = []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=50_000 + self.worker_index * 1000 + ep)
+            # per-episode pipeline clone: running stats are shared with
+            # training, per-episode state (frame stacks) restarts — and
+            # the training-time stacks are never polluted
+            pipeline = self.obs_connectors.clone_for_eval()
             total, done = 0.0, False
             while not done:
-                # eval must see the same preprocessing as training, but
-                # without polluting the training-time running stats
-                proc = self.obs_connectors.transform(np.asarray(obs)[None])
+                proc = pipeline(np.asarray(obs)[None])
                 a, _ = self.policy.compute_actions(proc, explore=False)
                 a = self.action_connectors.transform(a)
                 obs, r, term, trunc, _ = env.step(a[0])

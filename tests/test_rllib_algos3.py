@@ -156,7 +156,10 @@ def test_appo_learns_cartpole(cluster):
             .debugging(seed=0).build())
     assert algo._learner is not None  # async learner thread active
     best = 0.0
-    for _ in range(30):
+    # 45 iters: the async learner's sample/update interleaving is
+    # timing-dependent under 1-core suite contention — 30 was observed
+    # to land at 57.5 once with the whole suite running
+    for _ in range(45):
         r = algo.step()
         if not np.isnan(r["episode_reward_mean"]):
             best = max(best, r["episode_reward_mean"])
