@@ -329,6 +329,7 @@ def get_runtime_context() -> _RuntimeContext:
 
 
 def timeline() -> List[Dict[str, Any]]:
-    """Chrome-trace events (reference: ray timeline / state.py:414)."""
+    """Merged cross-process chrome-trace events (reference:
+    ray timeline / state.py:414 chrome_tracing_dump)."""
     from ray_tpu.util import timeline as _tl
-    return _tl.collect()
+    return _tl.timeline_dump()

@@ -64,6 +64,12 @@ class NodeInfo:
         self.alive = True
         self.last_seen = time.monotonic()
         self.is_head = bool(payload.get("is_head"))
+        # versioned sync state (reference: ray_syncer.h — per-node
+        # versioned snapshots; stale versions dropped, epoch guards
+        # against a restarted raylet's counter reset)
+        self.sync_epoch: float = float(payload.get("sync_epoch", 0.0))
+        self.sync_version: int = int(payload.get("sync_version", 0))
+        self.view_stamp: int = 0  # cluster-view version this entry last changed at
 
 
 class GcsServer:
@@ -76,6 +82,7 @@ class GcsServer:
         # rebuilds actors/PGs/jobs/KV. In-memory backend when no path given.
         self.store: StoreClient = make_store(store_path)
         self.nodes: Dict[str, NodeInfo] = {}
+        self._view_version = 0  # cluster-view sync version (ray_syncer)
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, Dict[str, Any]] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> id
@@ -107,6 +114,8 @@ class GcsServer:
             "register_node": self.register_node,
             "resource_report": self.resource_report,
             "get_nodes": self.get_nodes,
+            "profile_stacks": self.profile_stacks,
+            "get_node_stats": self.get_node_stats,
             "drain_node": self.drain_node,
             "kv_put": self.kv_put,
             "kv_get": self.kv_get,
@@ -133,6 +142,7 @@ class GcsServer:
             "get_placement_group": self.get_placement_group,
             "list_placement_groups": self.list_placement_groups,
             "subscribe": self.subscribe,
+            "unsubscribe": self.unsubscribe,
             "publish": self.publish,
             "add_object_location": self.add_object_location,
             "remove_object_location": self.remove_object_location,
@@ -241,8 +251,11 @@ class GcsServer:
         if node_id and node_id in self.nodes and self.nodes[node_id].alive \
                 and self.nodes[node_id].conn is conn:
             await self._mark_node_dead(node_id, "raylet disconnected")
-        for subs in self.subscribers.values():
+        for channel in list(self.subscribers):
+            subs = self.subscribers[channel]
             subs.discard(conn)
+            if not subs:
+                self.subscribers.pop(channel, None)
 
     # ------------------------------------------------------------- events
 
@@ -278,6 +291,7 @@ class GcsServer:
         if node is None:
             return
         node.alive = False
+        self._bump_view(node)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         self._event("ERROR", "NODE_DEAD",
                     f"node {node_id[:8]} died: {reason}",
@@ -298,10 +312,30 @@ class GcsServer:
 
     # ------------------------------------------------------------------- nodes
 
+    def _bump_view(self, node: "NodeInfo"):
+        """Advance the cluster-view version and stamp the changed entry
+        (the delta unit of the bidirectional sync stream)."""
+        self._view_version += 1
+        node.view_stamp = self._view_version
+
+    def _view_delta(self, since: int) -> Dict[str, Any]:
+        """Entries that changed after ``since`` — piggybacked on report
+        replies so every raylet converges on the cluster view without a
+        second RPC (reference: ray_syncer bidirectional stream)."""
+        delta = [{
+            "node_id": n.node_id,
+            "alive": n.alive,
+            "raylet_address": n.raylet_address,
+            "available": n.available_resources,
+            "total": n.total_resources,
+        } for n in self.nodes.values() if n.view_stamp > since]
+        return {"view_version": self._view_version, "delta": delta}
+
     async def register_node(self, payload, conn):
         node_id = payload["node_id"]
         info = NodeInfo(node_id, payload, conn)
         self.nodes[node_id] = info
+        self._bump_view(info)
         conn.meta["node_id"] = node_id
         # (re-)registration carries the node's primary object copies so a
         # restarted GCS rebuilds its object directory
@@ -320,9 +354,20 @@ class GcsServer:
         node = self.nodes.get(payload["node_id"])
         if node is None:
             return {}
+        # versioned stream: drop stale/reordered reports (same epoch,
+        # older version); a NEW epoch (restarted raylet, counter reset)
+        # always supersedes (reference: ray_syncer.h version filtering)
+        epoch = float(payload.get("sync_epoch", 0.0))
+        version = int(payload.get("sync_version", 0))
+        if epoch == node.sync_epoch and version <= node.sync_version \
+                and version:
+            node.last_seen = time.monotonic()
+            return self._view_delta(int(payload.get("known_view", 0)))
+        node.sync_epoch, node.sync_version = epoch, version
         node.available_resources = payload["available"]
         node.total_resources = payload.get("total", node.total_resources)
         node.last_seen = time.monotonic()
+        self._bump_view(node)
         # a fresh report supersedes older ephemeral allocations (the task
         # is either reflected in it or already finished) — keeping them
         # would double-count against the node
@@ -330,7 +375,7 @@ class GcsServer:
         if allocs:
             cutoff = time.monotonic() - 0.25
             allocs[:] = [(t, d) for t, d in allocs if t > cutoff]
-        return {}
+        return self._view_delta(int(payload.get("known_view", 0)))
 
     async def get_nodes(self, payload, conn):
         return [{
@@ -344,6 +389,42 @@ class GcsServer:
             "tpu": n.tpu,
             "is_head": n.is_head,
         } for n in self.nodes.values()]
+
+    async def _fanout_to_raylets(self, method: str, payload: Dict[str, Any],
+                                 node_id: Optional[str] = None,
+                                 timeout: float = 10.0) -> Dict[str, Any]:
+        """Concurrent RPC to every (or one) alive raylet; per-node
+        errors are folded into the result list rather than failing the
+        whole fan-out."""
+        targets = [n for n in list(self.nodes.values())
+                   if n.alive and (not node_id or n.node_id == node_id)]
+
+        async def one(n):
+            try:
+                return await asyncio.wait_for(
+                    n.conn.call(method, payload), timeout=timeout)
+            except Exception as e:
+                return {"node_id": n.node_id,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        return {"nodes": list(await asyncio.gather(
+            *[one(n) for n in targets]))}
+
+    async def profile_stacks(self, payload, conn):
+        """Fan a live-stack snapshot request out to raylets (reference:
+        the dashboard reporter's profile endpoints); node_id narrows to
+        one node, worker_id to one worker."""
+        return await self._fanout_to_raylets(
+            "dump_worker_stacks",
+            {"worker_id": payload.get("worker_id")},
+            node_id=payload.get("node_id"))
+
+    async def get_node_stats(self, payload, conn):
+        """Fan a node-stats snapshot out to raylet agents (reference:
+        dashboard head scraping per-node agents, dashboard/agent.py);
+        node_id narrows to one node."""
+        return await self._fanout_to_raylets(
+            "node_stats", {}, node_id=payload.get("node_id"))
 
     async def drain_node(self, payload, conn):
         await self._mark_node_dead(payload["node_id"], "drained")
@@ -432,6 +513,18 @@ class GcsServer:
     async def subscribe(self, payload, conn):
         for channel in payload["channels"]:
             self.subscribers.setdefault(channel, set()).add(conn)
+        return {}
+
+    async def unsubscribe(self, payload, conn):
+        """Drop channel subscriptions (and empty channel sets — the
+        per-object channels below would otherwise accumulate one entry
+        per ever-waited-on object)."""
+        for channel in payload["channels"]:
+            subs = self.subscribers.get(channel)
+            if subs is not None:
+                subs.discard(conn)
+                if not subs:
+                    self.subscribers.pop(channel, None)
         return {}
 
     async def publish(self, payload, conn):
@@ -1030,6 +1123,14 @@ class GcsServer:
         self.object_locations.setdefault(oid, set()).add(payload["node_id"])
         if payload.get("owner"):
             self.object_owners[oid] = payload["owner"]
+        # long-poll object channel (reference: GCS pubsub
+        # WORKER_OBJECT_LOCATIONS_CHANNEL): borrowers waiting on this
+        # object wake on the notification instead of polling the
+        # directory
+        if f"obj:{oid}" in self.subscribers:
+            await self._publish(f"obj:{oid}",
+                                {"object_id": oid,
+                                 "node_id": payload["node_id"]})
         return {}
 
     async def remove_object_location(self, payload, conn):

@@ -50,6 +50,8 @@ def _lib() -> ctypes.CDLL:
         lib.px_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.POINTER(ctypes.c_uint64),
                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.px_debug_lock.restype = ctypes.c_int
+        lib.px_debug_lock.argtypes = [ctypes.c_void_p]
         for name in ("px_seal", "px_abort", "px_release", "px_delete",
                      "px_contains", "px_pin", "px_refcount"):
             fn = getattr(lib, name)
@@ -81,12 +83,28 @@ DEFAULT_NSLOTS = 1 << 16
 
 
 class PlasmaxStore:
-    """Handle to one shared-memory segment (create or attach by path)."""
+    """Handle to one shared-memory segment (create or attach by path).
+
+    ``fallback_path`` names a second, disk-backed segment used when the
+    shm segment cannot satisfy an allocation even after spilling
+    (reference: plasma fallback allocation,
+    object_manager/plasma/create_request_queue.cc +
+    plasma_allocator.cc mmapping under /tmp when /dev/shm is
+    exhausted). The raylet creates it eagerly as a SPARSE file (no
+    disk used until pages are written); workers attach lazily on first
+    need, so the common path never touches it."""
 
     def __init__(self, path: str, capacity: int = 0, create: bool = False,
-                 nslots: int = DEFAULT_NSLOTS):
+                 nslots: int = DEFAULT_NSLOTS,
+                 fallback_path: Optional[str] = None,
+                 fallback_capacity: int = 0):
         self.path = path
         self._lib = _lib()
+        self.fallback_path = fallback_path
+        self._fallback: Optional["PlasmaxStore"] = None
+        # oids created-but-not-yet-sealed in the fallback segment: routes
+        # the seal/abort that follows a create to the right segment
+        self._fb_creating: set = set()
         if create:
             seg_size = self._lib.px_segment_size(capacity, nslots)
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
@@ -99,6 +117,16 @@ class PlasmaxStore:
             rc = self._lib.px_init(self._base, seg_size, nslots)
             if rc != 0:
                 raise RuntimeError(f"px_init failed: {rc}")
+            if fallback_path:
+                self._fallback = PlasmaxStore(
+                    fallback_path,
+                    capacity=fallback_capacity or capacity,
+                    create=True)
+                # sidecar makes the pair self-describing: attachers
+                # (workers, drivers) discover the overflow segment from
+                # the shm path alone — no plumbing through connect()
+                with open(path + ".fbpath", "w") as f:
+                    f.write(fallback_path)
         else:
             fd = os.open(path, os.O_RDWR)
             try:
@@ -109,37 +137,74 @@ class PlasmaxStore:
             self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
             if self._lib.px_attach_check(self._base) != 0:
                 raise RuntimeError(f"not a plasmax segment: {path}")
+            if self.fallback_path is None:
+                try:
+                    with open(path + ".fbpath") as f:
+                        self.fallback_path = f.read().strip() or None
+                except OSError:
+                    pass
         self._size = seg_size
+
+    def _fb(self) -> Optional["PlasmaxStore"]:
+        """The fallback segment, attaching lazily (readers)."""
+        if self._fallback is None and self.fallback_path and \
+                os.path.exists(self.fallback_path):
+            try:
+                self._fallback = PlasmaxStore(self.fallback_path)
+            except (OSError, RuntimeError):
+                self.fallback_path = None
+        return self._fallback
 
     # -- write path --
 
-    def create(self, oid: ObjectID, size: int) -> memoryview:
-        """Allocate and return a writable view; caller must seal()."""
+    def create(self, oid: ObjectID, size: int,
+               allow_fallback: bool = False) -> memoryview:
+        """Allocate and return a writable view; caller must seal().
+
+        ``allow_fallback`` is the last-resort switch: reference plasma
+        only fallback-allocates AFTER spilling failed to make room
+        (create_request_queue.cc), so callers opt in once their
+        spill-and-retry path is exhausted."""
+        if self._fallback is not None and self._fallback.contains(oid):
+            raise ValueError(f"object {oid} already exists")
         off = ctypes.c_uint64()
         rc = self._lib.px_create(self._base, oid.binary(), size, ctypes.byref(off))
         if rc == -1:
             raise ValueError(f"object {oid} already exists")
-        if rc == -2:
+        if rc in (-2, -3):
+            fb = self._fb() if allow_fallback else None
+            if fb is not None:
+                buf = fb.create(oid, size)  # disk-backed overflow
+                self._fb_creating.add(oid.binary())
+                return buf
             raise ObjectStoreFullError(
-                f"cannot allocate {size} bytes (capacity {self.capacity()}, "
-                f"used {self.used_bytes()})")
-        if rc == -3:
-            raise ObjectStoreFullError("object index full")
+                "object index full" if rc == -3 else
+                f"cannot allocate {size} bytes (capacity {self.capacity()},"
+                f" used {self.used_bytes()})")
         return memoryview(self._mm)[off.value:off.value + size]
 
     def seal(self, oid: ObjectID):
+        if oid.binary() in self._fb_creating:
+            self._fb_creating.discard(oid.binary())
+            self._fallback.seal(oid)
+            return
         rc = self._lib.px_seal(self._base, oid.binary())
         if rc != 0:
             raise ValueError(f"seal failed for {oid}: {rc}")
         # creator's implicit ref is dropped; raylet pins primaries separately
         self._lib.px_release(self._base, oid.binary())
 
-    def put_bytes(self, oid: ObjectID, data) -> None:
-        buf = self.create(oid, len(data))
+    def put_bytes(self, oid: ObjectID, data,
+                  allow_fallback: bool = False) -> None:
+        buf = self.create(oid, len(data), allow_fallback=allow_fallback)
         buf[:] = data
         self.seal(oid)
 
     def abort(self, oid: ObjectID):
+        if oid.binary() in self._fb_creating:
+            self._fb_creating.discard(oid.binary())
+            self._fallback.abort(oid)
+            return
         self._lib.px_abort(self._base, oid.binary())
 
     # -- read path --
@@ -151,24 +216,42 @@ class PlasmaxStore:
         rc = self._lib.px_get(self._base, oid.binary(), ctypes.byref(off),
                               ctypes.byref(size))
         if rc != 0:
-            return None
+            fb = self._fb()
+            return fb.get_buffer(oid) if fb is not None else None
         return memoryview(self._mm)[off.value:off.value + size.value]
 
     def release(self, oid: ObjectID):
-        self._lib.px_release(self._base, oid.binary())
+        if self._lib.px_release(self._base, oid.binary()) != 0:
+            fb = self._fb()
+            if fb is not None:
+                fb.release(oid)
 
     def delete(self, oid: ObjectID) -> bool:
-        return self._lib.px_delete(self._base, oid.binary()) == 0
+        if self._lib.px_delete(self._base, oid.binary()) == 0:
+            return True
+        fb = self._fb()
+        return fb.delete(oid) if fb is not None else False
 
     def contains(self, oid: ObjectID) -> bool:
-        return bool(self._lib.px_contains(self._base, oid.binary()))
+        if self._lib.px_contains(self._base, oid.binary()):
+            return True
+        fb = self._fb()
+        return fb.contains(oid) if fb is not None else False
 
     def refcount(self, oid: ObjectID) -> int:
         """Debug: shared refcount of the slot, -1 if absent."""
-        return int(self._lib.px_refcount(self._base, oid.binary()))
+        rc = int(self._lib.px_refcount(self._base, oid.binary()))
+        if rc < 0:
+            fb = self._fb()
+            if fb is not None:
+                return fb.refcount(oid)
+        return rc
 
     def pin(self, oid: ObjectID) -> bool:
-        return self._lib.px_pin(self._base, oid.binary()) == 0
+        if self._lib.px_pin(self._base, oid.binary()) == 0:
+            return True
+        fb = self._fb()
+        return fb.pin(oid) if fb is not None else False
 
     # -- stats --
 
@@ -186,17 +269,29 @@ class PlasmaxStore:
         self._lib.px_stats(self._base, arr)
         keys = ("used_bytes", "capacity", "num_objects", "num_created",
                 "num_evicted", "bytes_evicted")
-        return dict(zip(keys, arr))
+        out = dict(zip(keys, arr))
+        # shm-segment numbers stay primary-only (the raylet's spill
+        # thresholds act on shm health); disk overflow reports separately
+        fb = self._fb()
+        if fb is not None:
+            fbs = fb.stats()
+            out["fallback_used_bytes"] = fbs["used_bytes"]
+            out["fallback_capacity"] = fbs["capacity"]
+            out["fallback_objects"] = fbs["num_objects"]
+        return out
 
     def close(self):
         # Views into the mmap must be gone before closing; callers own that.
         self._base = None
 
     def unlink(self):
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+        for p in (self.path, self.path + ".fbpath",
+                  self.fallback_path or ""):
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
 
 class MemoryStore:

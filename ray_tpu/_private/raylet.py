@@ -319,20 +319,63 @@ class Raylet:
         store_path = os.path.join("/dev/shm" if os.path.isdir("/dev/shm")
                                   else session_dir,
                                   f"rtpu_plasmax_{node_id[:12]}")
+        # disk-backed overflow segment (reference: plasma fallback
+        # allocation under /tmp, create_request_queue.cc). Sparse file:
+        # costs no disk until an allocation actually overflows.
+        fb_dir = config.object_store_fallback_dir or session_dir
         self.store = PlasmaxStore(
             store_path,
             capacity=int(object_store_memory
                          or config.object_store_memory_bytes),
-            create=True)
+            create=True,
+            fallback_path=os.path.join(
+                fb_dir, f"rtpu_plasmax_{node_id[:12]}.fb"))
         self.store_path = store_path
 
+        # pull admission: bounds the BYTES of concurrent inbound pulls
+        # so a burst of fetches can't blow the store (reference:
+        # pull_manager.cc admission under memory pressure). Lazily
+        # created on the event loop.
+        self._pull_inflight_bytes = 0
+        self._pull_waiters: Optional[Any] = None
+        # push manager state: (oid, target) pairs with a push in flight
+        # (dedup, reference: push_manager.cc)
+        self._pushes_inflight: set = set()
+        # oid hex -> [open buffer, last-chunk monotonic time]: the time
+        # lets an interrupted push (sender died mid-stream) be reaped —
+        # an unsealed create would otherwise brick the object here
+        self._inbound_pushes: Dict[str, list] = {}
+        # oid hex -> future: one active pull per object; followers await
+        self._inflight_fetches: Dict[str, Any] = {}
         # object spilling (reference: local_object_manager.h:110 SpillObjects
-        # + _private/external_storage.py filesystem backend): pinned primary
-        # copies are written to disk under session_dir and deleted from shm
-        # when the store crosses the spill threshold; restored on demand.
+        # + _private/external_storage.py): pinned primary copies go to a
+        # pluggable ExternalStorage backend (filesystem default; S3/URI
+        # via smart_open; the ray_storage cluster root) when the store
+        # crosses the spill threshold; restored on demand by URI.
+        from ray_tpu._private.external_storage import storage_from_config
         self.spill_dir = os.path.join(session_dir, f"spill_{node_id[:12]}")
-        self.spilled: Dict[str, Tuple[str, int]] = {}  # oid hex -> (path, size)
+        self.spill_storage = storage_from_config(
+            config.object_spilling_config, self.spill_dir, node_id,
+            storage_root=os.environ.get("RTPU_STORAGE"))
+        self.spilled: Dict[str, Tuple[str, int]] = {}  # oid hex -> (uri, size)
         self.pinned: Dict[str, Dict[str, Any]] = {}  # oid hex -> {owner}, FIFO
+        # lifetime counters for the node-stats agent (reference:
+        # metric_defs.cc ray_spill_manager_* / scheduler counters)
+        self._spill_count = 0
+        self._spilled_bytes_total = 0
+        self._restore_count = 0
+        self._restored_bytes_total = 0
+        self._tasks_dispatched_total = 0
+        self._tasks_spilled_back_total = 0
+        self._prev_cpu_sample: Optional[Tuple[float, float]] = None
+        # versioned sync stream state (reference: ray_syncer.h): the
+        # epoch distinguishes this process generation; the version
+        # orders its reports; known_view tracks the GCS cluster-view
+        # deltas already folded into cluster_view
+        self._sync_epoch = time.time()
+        self._sync_version = 0
+        self._known_view_version = 0
+        self.cluster_view: Dict[str, Dict[str, Any]] = {}
         # Serializes spill/restore. Two concurrent _spill_one calls on the
         # same object each hold a read ref, so each sees the other's ref as
         # "a reader", refuses the delete, and re-pins — leaving the refcount
@@ -373,12 +416,15 @@ class Raylet:
             "cancel_bundle": self.handle_cancel_bundle,
             "return_bundle": self.handle_return_bundle,
             "pull_object": self.handle_pull_object,
+            "receive_push": self.handle_receive_push,
             "fetch_object": self.handle_fetch_object,
             "free_objects": self.handle_free_objects,
             "pin_object": self.handle_pin_object,
             "request_spill": self.handle_request_spill,
             "contains_object": self.handle_contains_object,
             "get_info": self.handle_get_info,
+            "node_stats": self.handle_node_stats,
+            "dump_worker_stacks": self.handle_dump_worker_stacks,
             "cancel_task": self.handle_cancel_task,
             "_on_disconnect": self._on_disconnect,
         }
@@ -427,11 +473,16 @@ class Raylet:
             # object directory (which is not persisted; locations are
             # node-volatile state, reference: gcs re-subscribes raylets)
             "objects": [h for h in self.pinned] + list(self.spilled),
+            "sync_epoch": self._sync_epoch,
+            "sync_version": self._sync_version,
         }
 
     async def _on_gcs_reconnect(self, conn):
         """GCS restarted: re-register this node + its object locations."""
         try:
+            # the restarted GCS's view counter restarts too — a stale
+            # known_view would make us ignore its deltas forever
+            self._known_view_version = 0
             await conn.call("register_node", self._register_payload())
             logger.info("re-registered with restarted GCS")
         except Exception as e:
@@ -515,9 +566,21 @@ class Raylet:
         err_path = os.path.join(log_base, f"worker-{worker_id}.err")
         out = open(out_path, "ab")
         err = open(err_path, "ab")
+        cmd = [python_exe, "-m", "ray_tpu._private.default_worker"]
+        if runtime_env.get("container"):
+            # containerized worker (reference: runtime_env/container.py):
+            # the runtime prefix mounts session dir + env cache and
+            # forwards the bootstrap env by key (values come from
+            # Popen(env=...) below)
+            from ray_tpu._private import runtime_env as renv
+            cmd = renv.container_command(
+                runtime_env["container"], self.session_dir,
+                self._runtime_env_cache_dir,
+                env_keys=[k for k in env
+                          if k.startswith(("RTPU_", "JAX_", "PYTHON",
+                                           "TPU_"))]) + cmd
         proc = subprocess.Popen(
-            [python_exe, "-m", "ray_tpu._private.default_worker"],
-            env=env, cwd=cwd, stdout=out, stderr=err,
+            cmd, env=env, cwd=cwd, stdout=out, stderr=err,
             start_new_session=True)
         handle = WorkerHandle(worker_id, proc,
                               runtime_env_hash=_env_hash(runtime_env),
@@ -531,6 +594,7 @@ class Raylet:
             else {}
         menv = None
         if runtime_env and (runtime_env.get("pip")
+                            or runtime_env.get("conda")
                             or runtime_env.get("py_modules")
                             or str(runtime_env.get("working_dir", ""))
                             .startswith("gcs://")):
@@ -880,11 +944,25 @@ class Raylet:
             return None
         spec = dict(ptask.spec)
         spec["spilled_from"] = self.node_id
+        # proactive dep push (push manager): overlap the transfer of
+        # locally-held args with the peer's worker startup instead of
+        # serializing behind its on-demand pull. Deliberately launched
+        # BEFORE the submit (the peer's dispatch pulls missing deps
+        # straight away); a failed submit then costs a redundant replica
+        # on the peer, which eviction reclaims.
+        loop = asyncio.get_running_loop()
+        for d in spec.get("plasma_deps") or []:
+            doid = ObjectID.from_hex(d)
+            if self.store.contains(doid):
+                loop.create_task(self.push_object(
+                    doid, r["raylet_address"], nid))
         try:
             remote = await self._raylet_peer(r["raylet_address"])
-            return await remote.call("submit_task", spec)
+            reply = await remote.call("submit_task", spec)
         except Exception:
             return None
+        self._tasks_spilled_back_total += 1
+        return reply
 
     async def _raylet_peer(self, address: str) -> "protocol.Connection":
         """Cached connection to a peer raylet (spillback reuses it; a
@@ -1003,6 +1081,7 @@ class Raylet:
         handle.busy_task = ptask.spec["task_id"]
         handle.job_id = ptask.spec.get("job_id") or handle.job_id
         handle.num_tasks += 1
+        self._tasks_dispatched_total += 1
         self._running_tasks[ptask.spec["task_id"]] = (handle, ptask)
         try:
             push = {"spec": ptask.spec, "tpu_chips": list(chips)}
@@ -1040,6 +1119,26 @@ class Raylet:
             self._push_idle(handle)
         self._dispatch_event.set()
         return {}
+
+    async def handle_dump_worker_stacks(self, payload, conn):
+        """On-demand live stack snapshot of this node's workers
+        (reference: dashboard/modules/reporter/profile_manager.py).
+        payload.worker_id narrows to one worker; default = all."""
+        want = payload.get("worker_id")
+        out = []
+        for wid, handle in list(self.workers.items()):
+            if want and wid != want:
+                continue
+            if handle.conn is None:
+                continue
+            try:
+                r = await asyncio.wait_for(
+                    handle.conn.call("dump_stacks", {}), timeout=5)
+                out.append(r)
+            except Exception as e:
+                out.append({"worker_id": wid,
+                            "error": f"{type(e).__name__}: {e}"})
+        return {"node_id": self.node_id, "workers": out}
 
     async def handle_cancel_task(self, payload, conn):
         task_id = payload["task_id"]
@@ -1214,8 +1313,51 @@ class Raylet:
             buf.release()
             self.store.release(oid)
 
+    async def _admit_pull(self, nbytes: int):
+        """Block until `nbytes` of inbound-pull budget is available
+        (reference: pull_manager.cc caps in-flight pull bytes under
+        memory pressure so a fetch burst can't blow the store)."""
+        if self._pull_waiters is None:
+            self._pull_waiters = asyncio.Condition()
+        budget = max(
+            CHUNK,
+            int(self.store.capacity()
+                * self.config.pull_admission_fraction))
+        nbytes = min(nbytes, budget)  # one giant object always admits
+        async with self._pull_waiters:
+            while self._pull_inflight_bytes + nbytes > budget:
+                await self._pull_waiters.wait()
+            self._pull_inflight_bytes += nbytes
+        return nbytes
+
+    async def _release_pull(self, nbytes: int):
+        async with self._pull_waiters:
+            self._pull_inflight_bytes -= nbytes
+            self._pull_waiters.notify_all()
+
     async def _fetch_remote_object(self, oid: ObjectID):
         """Pull an object from another node into the local store."""
+        # dedup concurrent pulls of one object (reference:
+        # pull_manager.cc tracks one active pull per object): followers
+        # await the leader's outcome instead of racing on the create
+        fut = self._inflight_fetches.get(oid.hex())
+        if fut is not None:
+            await fut
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight_fetches[oid.hex()] = fut
+        try:
+            await self._fetch_remote_object_once(oid)
+            fut.set_result(None)
+        except BaseException as e:
+            fut.set_exception(e)
+            # consume the exception if nobody awaits the future
+            fut.exception()
+            raise
+        finally:
+            self._inflight_fetches.pop(oid.hex(), None)
+
+    async def _fetch_remote_object_once(self, oid: ObjectID):
         if oid.hex() in self.spilled:  # our own disk copy: restore, done
             if await self._restore_spilled(oid):
                 return
@@ -1223,7 +1365,9 @@ class Raylet:
                                 {"object_id": oid.hex()})
         locs = [l for l in r["locations"] if l["node_id"] != self.node_id]
         last_err = None
-        for loc in locs:
+        # two passes: a replica skipped because a (then-live, since
+        # reaped) inbound push held the slot deserves one retry
+        for loc in locs + locs:
             try:
                 remote = await protocol.connect(loc["raylet_address"])
                 try:
@@ -1234,30 +1378,161 @@ class Raylet:
                     total = first["total_size"]
                     if self.store.contains(oid):
                         return
-                    buf = self.store.create(oid, total)
-                    data = first["data"]
-                    buf[:len(data)] = data
-                    got = len(data)
-                    while got < total:
-                        chunk = await remote.call("pull_object", {
-                            "object_id": oid.hex(), "offset": got,
-                            "length": CHUNK})
-                        d = chunk["data"]
-                        buf[got:got + len(d)] = d
-                        got += len(d)
-                    buf.release()
-                    self.store.seal(oid)
+                    admitted = await self._admit_pull(total)
+                    try:
+                        if self.store.contains(oid):
+                            return
+                        try:
+                            try:
+                                buf = self.store.create(oid, total)
+                            except ValueError:
+                                # slot taken but object not sealed: an
+                                # interrupted inbound push holds it —
+                                # reap and take over (a LIVE push or a
+                                # concurrent fetch re-raises → handled
+                                # by the wait loop below)
+                                if not self._abort_stale_push(
+                                        oid.hex(), max_age=10.0):
+                                    raise
+                                buf = self.store.create(oid, total)
+                        except ObjectStoreFullError:
+                            await self._spill_until(total)
+                            buf = self.store.create(oid, total,
+                                                    allow_fallback=True)
+                        try:
+                            data = first["data"]
+                            buf[:len(data)] = data
+                            got = len(data)
+                            while got < total:
+                                chunk = await remote.call("pull_object", {
+                                    "object_id": oid.hex(), "offset": got,
+                                    "length": CHUNK})
+                                d = chunk["data"]
+                                buf[got:got + len(d)] = d
+                                got += len(d)
+                        except BaseException:
+                            # never leak an unsealed create: it would
+                            # brick the object on this node
+                            buf.release()
+                            self.store.abort(oid)
+                            raise
+                        buf.release()
+                        self.store.seal(oid)
+                    finally:
+                        await self._release_pull(admitted)
                     await self.gcs.call("add_object_location", {
                         "object_id": oid.hex(), "node_id": self.node_id})
                     return
                 finally:
                     remote.close()
-            except ValueError:
-                return  # concurrent fetch completed
+            except ValueError as e:
+                # a LIVE inbound push holds the slot (same-process
+                # fetches are deduped above): wait for its seal,
+                # reaping it if it goes stale so we can retry
+                for _ in range(120):
+                    if self.store.contains(oid):
+                        return
+                    if self._abort_stale_push(oid.hex(), max_age=10.0):
+                        break  # interrupted push reaped — retry pull
+                    await asyncio.sleep(0.25)
+                last_err = e
             except Exception as e:  # try next replica
                 last_err = e
         raise RuntimeError(f"could not fetch {oid}: no live copies "
                            f"({last_err})")
+
+    # -------------------------------------------------------- push manager
+
+    async def push_object(self, oid: ObjectID, target_address: str,
+                          target_node_id: str):
+        """Proactively push a local object to a peer raylet (reference:
+        push_manager.cc — chunked pushes with in-flight dedup). Used
+        when this node spills a task to a peer whose args live here:
+        the transfer overlaps the peer's worker startup instead of
+        serializing behind its on-demand pull."""
+        key = (oid.hex(), target_node_id)
+        if key in self._pushes_inflight:
+            return
+        self._pushes_inflight.add(key)
+        try:
+            buf = self.store.get_buffer(oid)
+            if buf is None:
+                return
+            try:
+                total = len(buf)
+                remote = await self._raylet_peer(target_address)
+                offset = 0
+                while offset < total:
+                    n = min(CHUNK, total - offset)
+                    r = await remote.call("receive_push", {
+                        "object_id": oid.hex(), "offset": offset,
+                        "total_size": total,
+                        "data": bytes(buf[offset:offset + n])})
+                    if not r.get("ok"):
+                        return  # peer declined (full / already has it)
+                    offset += n
+            finally:
+                buf.release()
+                self.store.release(oid)
+        except Exception:
+            logger.debug("push of %s to %s failed", oid.hex()[:16],
+                         target_node_id[:8], exc_info=True)
+        finally:
+            self._pushes_inflight.discard(key)
+
+    def _abort_stale_push(self, hex_id: str, max_age: float) -> bool:
+        """Abort an interrupted inbound push older than ``max_age`` so
+        its unsealed create doesn't brick the object on this node.
+        True if the slot is now free (no entry, or entry reaped)."""
+        ent = self._inbound_pushes.get(hex_id)
+        if ent is None:
+            return True
+        if time.monotonic() - ent[1] < max_age:
+            return False  # still streaming
+        self._inbound_pushes.pop(hex_id, None)
+        try:
+            ent[0].release()
+        except Exception:
+            pass
+        self.store.abort(ObjectID.from_hex(hex_id))
+        return True
+
+    async def handle_receive_push(self, payload, conn):
+        """Inbound proactive push: admit by byte budget, buffer chunks
+        into an unsealed create, seal on the last one."""
+        oid = ObjectID.from_hex(payload["object_id"])
+        total = payload["total_size"]
+        if self.store.contains(oid):
+            return {"ok": False, "reason": "present"}
+        if payload["offset"] == 0:
+            # a retried push supersedes an interrupted predecessor
+            if not self._abort_stale_push(oid.hex(), max_age=10.0):
+                return {"ok": False, "reason": "push in progress"}
+            admitted = await self._admit_pull(total)
+            try:
+                try:
+                    self._inbound_pushes[oid.hex()] = \
+                        [self.store.create(oid, total), time.monotonic()]
+                except ObjectStoreFullError:
+                    return {"ok": False, "reason": "full"}
+                except ValueError:
+                    return {"ok": False, "reason": "present"}
+            finally:
+                await self._release_pull(admitted)
+        ent = self._inbound_pushes.get(oid.hex())
+        if ent is None:
+            return {"ok": False, "reason": "no create"}
+        buf = ent[0]
+        ent[1] = time.monotonic()
+        data = payload["data"]
+        buf[payload["offset"]:payload["offset"] + len(data)] = data
+        if payload["offset"] + len(data) >= total:
+            buf.release()
+            self._inbound_pushes.pop(oid.hex(), None)
+            self.store.seal(oid)
+            await self.gcs.call("add_object_location", {
+                "object_id": oid.hex(), "node_id": self.node_id})
+        return {"ok": True}
 
     async def handle_fetch_object(self, payload, conn):
         await self._fetch_remote_object(ObjectID.from_hex(payload["object_id"]))
@@ -1283,8 +1558,8 @@ class Raylet:
             ent = self.spilled.pop(hex_id, None)
             if ent is not None:
                 try:
-                    os.unlink(ent[0])
-                except OSError:
+                    self.spill_storage.delete(ent[0])
+                except Exception:
                     pass
             try:
                 await self.gcs.call("remove_object_location", {
@@ -1352,7 +1627,6 @@ class Raylet:
             logger.debug("spill_one %s: no buffer", hex_id[:16])
             self.pinned.pop(hex_id, None)
             return False
-        path = os.path.join(self.spill_dir, hex_id)
         try:
             data = bytes(buf)
         finally:
@@ -1360,21 +1634,24 @@ class Raylet:
             self.store.release(oid)  # the get_buffer ref
         loop = asyncio.get_running_loop()
         try:
-            await loop.run_in_executor(None, _write_file, path, data)
-        except OSError:
+            uri = await loop.run_in_executor(
+                None, self.spill_storage.spill, hex_id, data)
+        except Exception:
+            logger.warning("spill of %s failed", hex_id[:16],
+                           exc_info=True)
             return False
         self.store.release(oid)  # the pin ref
         if not self.store.delete(oid):
             # a reader still maps it: leave it in shm, undo the spill
             logger.debug("spill_one %s: delete refused (readers)", hex_id[:16])
             self.store.pin(oid)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            await loop.run_in_executor(None, self.spill_storage.delete,
+                                       uri)
             return False
         self.pinned.pop(hex_id, None)
-        self.spilled[hex_id] = (path, len(data))
+        self.spilled[hex_id] = (uri, len(data))
+        self._spill_count += 1
+        self._spilled_bytes_total += len(data)
         # the GCS location entry stays: this node still owns the primary
         # copy (on disk); pulls/gets restore it transparently.
         return True
@@ -1386,18 +1663,21 @@ class Raylet:
             ent = self.spilled.get(oid.hex())
             if ent is None:
                 return False
-            path, size = ent
+            uri, size = ent
             loop = asyncio.get_running_loop()
             try:
-                data = await loop.run_in_executor(None, _read_file, path)
-            except OSError:
+                data = await loop.run_in_executor(
+                    None, self.spill_storage.restore, uri)
+            except Exception:
+                logger.warning("restore of %s from %s failed",
+                               oid.hex()[:16], uri, exc_info=True)
                 return False
             try:
                 self.store.put_bytes(oid, data)
             except ObjectStoreFullError:
                 await self._spill_until_locked(len(data))
                 try:
-                    self.store.put_bytes(oid, data)
+                    self.store.put_bytes(oid, data, allow_fallback=True)
                 except ObjectStoreFullError:
                     return False
             except ValueError:
@@ -1405,10 +1685,10 @@ class Raylet:
             if self.store.pin(oid):
                 self.pinned[oid.hex()] = {"owner": None}
             self.spilled.pop(oid.hex(), None)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            self._restore_count += 1
+            self._restored_bytes_total += size
+            await loop.run_in_executor(None, self.spill_storage.delete,
+                                       uri)
             return True
 
     async def handle_get_info(self, payload, conn):
@@ -1421,6 +1701,93 @@ class Raylet:
             "num_workers": len(self.workers),
             "num_pending_tasks": len(self.pending),
             "tpu": self.tpu_info,
+        }
+
+    def _physical_stats(self) -> Dict[str, float]:
+        """Host cpu/mem/disk readings from /proc — the per-node agent's
+        reporter role (reference: dashboard/agent.py + modules/reporter
+        reporter_agent.py, psutil there; /proc directly here)."""
+        out: Dict[str, float] = {}
+        try:
+            with open("/proc/meminfo") as f:
+                mem = {}
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    mem[k] = float(rest.split()[0]) * 1024  # kB -> bytes
+            out["mem_total_bytes"] = mem.get("MemTotal", 0.0)
+            out["mem_available_bytes"] = mem.get("MemAvailable", 0.0)
+        except OSError:
+            pass
+        try:
+            with open("/proc/stat") as f:
+                parts = f.readline().split()[1:]
+            vals = [float(x) for x in parts]
+            busy, total = sum(vals) - vals[3] - vals[4], sum(vals)
+            prev = self._prev_cpu_sample
+            self._prev_cpu_sample = (busy, total)
+            if prev and total > prev[1]:
+                out["cpu_percent"] = 100.0 * (busy - prev[0]) \
+                    / (total - prev[1])
+        except (OSError, IndexError, ValueError):
+            pass
+        try:
+            st = os.statvfs(self.spill_dir
+                            if os.path.isdir(self.spill_dir)
+                            else self.session_dir)
+            out["disk_free_bytes"] = float(st.f_bavail * st.f_frsize)
+        except OSError:
+            pass
+        try:
+            out["load_avg_1m"] = os.getloadavg()[0]
+        except OSError:
+            pass
+        return out
+
+    async def handle_node_stats(self, payload, conn):
+        """Per-node agent snapshot: physical + scheduler + object-plane
+        gauges (reference: dashboard/agent.py reporting and the native
+        metric set in src/ray/stats/metric_defs.cc — scheduler task
+        counts, plasma usage, spill totals)."""
+        idle = sum(len(v) for v in self.idle_workers.values())
+        running = sum(1 for h in self.workers.values() if h.busy_task)
+        actors = sum(1 for h in self.workers.values() if h.is_actor)
+        store = self.store.stats()
+        return {
+            "node_id": self.node_id,
+            "physical": self._physical_stats(),
+            "scheduler": {
+                "tasks_pending": len(self.pending),
+                "tasks_running": running,
+                "tasks_dispatched_total": self._tasks_dispatched_total,
+                "tasks_spilled_back_total": self._tasks_spilled_back_total,
+                "workers_alive": len(self.workers),
+                "workers_idle": idle,
+                "actors_alive": actors,
+                "resources_total": dict(self.total_resources),
+                "resources_available": dict(self.available),
+                # versioned sync stream position (ray_syncer analogue)
+                "sync_version": self._sync_version,
+                "known_view_version": self._known_view_version,
+                "cluster_view_nodes": len(self.cluster_view),
+            },
+            "object_store": {
+                **{k: int(v) for k, v in store.items()},
+                "pinned_objects": len(self.pinned),
+                "spilled_objects": len(self.spilled),
+                "spilled_bytes_current": sum(
+                    s for _, s in self.spilled.values()),
+                "spill_count_total": self._spill_count,
+                "spilled_bytes_total": self._spilled_bytes_total,
+                "restore_count_total": self._restore_count,
+                "restored_bytes_total": self._restored_bytes_total,
+                "pull_inflight_bytes": self._pull_inflight_bytes,
+                "pushes_inflight": len(self._pushes_inflight),
+            },
+            "tpu": {
+                "num_chips": int(self.total_resources.get("TPU", 0)),
+                "chips_available": int(self.available.get("TPU", 0)),
+                **(self.tpu_info or {}),
+            },
         }
 
     # ---------------------------------------------------------------- report
@@ -1584,14 +1951,39 @@ class Raylet:
             await asyncio.sleep(period)
 
     async def _send_report(self):
+        """One tick of the versioned bidirectional sync stream
+        (reference: ray_syncer.h — versioned snapshots up, cluster-view
+        deltas down on the same exchange)."""
+        self._sync_version += 1
         try:
-            await self.gcs.call("resource_report", {
+            reply = await self.gcs.call("resource_report", {
                 "node_id": self.node_id,
                 "available": self.available,
                 "total": self.total_resources,
+                "sync_epoch": self._sync_epoch,
+                "sync_version": self._sync_version,
+                "known_view": self._known_view_version,
             })
         except Exception:
-            pass
+            return
+        self._apply_view_delta(reply or {})
+
+    def _apply_view_delta(self, reply: Dict[str, Any]):
+        """Fold the GCS's cluster-view delta into the local cache and
+        retire peer connections to nodes the view says are dead."""
+        if reply.get("view_version", 0) <= self._known_view_version:
+            return
+        self._known_view_version = reply["view_version"]
+        for ent in reply.get("delta") or ():
+            self.cluster_view[ent["node_id"]] = ent
+            if not ent["alive"]:
+                conn = self._peer_raylets.pop(
+                    ent["raylet_address"], None)
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
 
     def report_soon(self):
         """Event-driven report push (debounced): resource releases reach

@@ -15,6 +15,11 @@ content-addressed per-host cache, and workers are pooled keyed by env hash
   py_modules:  list of local dirs/files or URIs (prepended to PYTHONPATH)
   pip:         list of requirement specs / local wheel paths
                (installed into a venv with --system-site-packages)
+  conda:       env name / env dir / {"dependencies": [...]} spec
+               (gated on a conda binary being installed on the host)
+  container:   {"image": ..., "run_options": [...]} — worker runs inside
+               the image via podman/docker (gated on the runtime binary;
+               reference: runtime_env/container.py worker_setup_hook)
 """
 
 from __future__ import annotations
@@ -215,6 +220,89 @@ def _build_pip_venv_locked(reqs: List[str], venv_dir: str, py: str,
     return py
 
 
+def _conda_exe() -> Optional[str]:
+    """The host's conda binary, if any (reference: conda.py get_conda_activate_commands
+    resolving $CONDA_EXE). None means the feature is unavailable here."""
+    exe = os.environ.get("CONDA_EXE") or shutil.which("conda")
+    return exe if exe and os.path.exists(exe) else None
+
+
+def _ensure_conda_env(spec: Any, cache_dir: str) -> str:
+    """Resolve/create a conda env and return its python executable
+    (reference: runtime_env/conda.py — named envs activate in place,
+    dict specs materialize a content-addressed env). Raises RuntimeError
+    when conda isn't installed — the feature is gated, not stubbed."""
+    conda = _conda_exe()
+    if conda is None:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda installation "
+            "(none found via $CONDA_EXE or PATH)")
+    if isinstance(spec, str):
+        if os.path.isdir(spec):  # explicit env dir
+            return os.path.join(spec, "bin", "python")
+        base = subprocess.check_output(
+            [conda, "info", "--base"], text=True).strip()
+        env_dir = os.path.join(base, "envs", spec)
+        py = os.path.join(env_dir, "bin", "python")
+        if not os.path.exists(py):
+            raise RuntimeError(f"conda env {spec!r} not found at {env_dir}")
+        return py
+    # dict spec: content-addressed env under the shared cache, guarded by
+    # the same flock discipline as pip venvs
+    import fcntl
+    key = hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+    env_dir = os.path.join(cache_dir, "conda", key)
+    py = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".ready")
+    if os.path.exists(marker):
+        return py
+    os.makedirs(os.path.join(cache_dir, "conda"), exist_ok=True)
+    lock_path = os.path.join(cache_dir, "conda", f".{key}.lock")
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return py
+        shutil.rmtree(env_dir, ignore_errors=True)
+        yml = os.path.join(cache_dir, "conda", f"{key}.yml")
+        with open(yml, "w") as f:
+            json.dump(spec, f)  # YAML is a JSON superset
+        subprocess.check_call(
+            [conda, "env", "create", "-p", env_dir, "-f", yml, "--yes"],
+            stdout=subprocess.DEVNULL)
+        open(marker, "w").close()
+    return py
+
+
+def container_command(container: Dict[str, Any], session_dir: str,
+                      cache_dir: str,
+                      env_keys: Optional[List[str]] = None) -> List[str]:
+    """Command prefix that wraps the worker in a container (reference:
+    runtime_env/container.py — podman run with the session dir mounted).
+    Gated on a runtime binary: $RTPU_CONTAINER_RUNTIME overrides the
+    podman/docker PATH lookup (and is how tests inject a fake).
+    ``env_keys`` are forwarded with bare ``-e KEY`` (both podman and
+    docker then read the value from the spawning environment, which the
+    raylet populates via Popen(env=...))."""
+    image = container.get("image")
+    if not image:
+        raise RuntimeError("runtime_env['container'] requires 'image'")
+    runtime = os.environ.get("RTPU_CONTAINER_RUNTIME") or \
+        shutil.which("podman") or shutil.which("docker")
+    if not runtime:
+        raise RuntimeError(
+            "runtime_env['container'] requires podman or docker "
+            "(none found; set RTPU_CONTAINER_RUNTIME to override)")
+    cmd = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+           "-v", f"{session_dir}:{session_dir}",
+           "-v", f"{cache_dir}:{cache_dir}"]
+    for k in env_keys or ():
+        cmd += ["-e", k]
+    cmd += list(container.get("run_options") or [])
+    cmd.append(image)
+    return cmd
+
+
 def materialize(runtime_env: Optional[Dict[str, Any]], cache_dir: str,
                 kv_get: Callable[[str], Optional[bytes]]
                 ) -> MaterializedEnv:
@@ -238,10 +326,19 @@ def materialize(runtime_env: Optional[Dict[str, Any]], cache_dir: str,
         elif os.path.exists(mod):
             m.pythonpath.append(os.path.abspath(
                 os.path.dirname(mod) if os.path.isfile(mod) else mod))
+    if runtime_env.get("pip") and runtime_env.get("conda"):
+        # the reference rejects this combination too (validation.py):
+        # pip packages inside a conda env go in the conda spec's
+        # nested {"dependencies": [..., {"pip": [...]}]} form
+        raise ValueError(
+            "runtime_env cannot specify both 'pip' and 'conda'; put pip "
+            "packages inside the conda spec's dependencies.pip list")
     if runtime_env.get("pip"):
         reqs = list(runtime_env["pip"]) if not isinstance(
             runtime_env["pip"], dict) else \
             list(runtime_env["pip"].get("packages", []))
         m.python_exe = _ensure_pip_venv(reqs, cache_dir)
+    if runtime_env.get("conda"):
+        m.python_exe = _ensure_conda_env(runtime_env["conda"], cache_dir)
     m.pythonpath = [p for p in m.pythonpath if p]
     return m

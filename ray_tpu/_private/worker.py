@@ -404,8 +404,24 @@ class Worker:
         self._cancelled_tasks: set = set()
         self.tpu_chips: List[int] = []
         self._server: Optional[protocol.Server] = None
-        self._actor_seq: Dict[Tuple[str, str], int] = {}
-        self._actor_waiting: Dict[Tuple[str, str], Dict[int, Any]] = {}
+        # receive side: highest actor-call seq dispatched per caller +
+        # parked out-of-order arrivals (reference:
+        # actor_scheduling_queue.cc ordering by sequence_no)
+        self._actor_seq: Dict[str, int] = {}
+        self._actor_waiting: Dict[str, Dict[int, Any]] = {}
+        # send side: per-actor monotone counters, program-order allocated,
+        # plus the contiguous completed-prefix ("processed up to") that
+        # rides every call so a restarted actor learns its baseline from
+        # the first message instead of stalling on a phantom gap
+        # (reference: actor_scheduling_queue.cc client_processed_up_to)
+        self._actor_send_seq: Dict[str, int] = {}
+        self._actor_done_seqs: Dict[str, set] = {}
+        self._actor_processed_upto: Dict[str, int] = {}
+        self._actor_send_lock = threading.Lock()
+        # per-object location channels (long-poll pubsub): hex -> [Event,
+        # waiter refcount]
+        self._obj_channels: Dict[str, list] = {}
+        self._obj_channel_lock = threading.Lock()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -513,12 +529,45 @@ class Worker:
             "exit_worker": self._h_exit_worker,
             "ping": self._h_ping,
             "pubsub": self._h_pubsub,
+            "dump_stacks": self._h_dump_stacks,
         }
+
+    async def _h_dump_stacks(self, payload, conn):
+        """Live stack snapshot of every thread in this process
+        (reference: dashboard/modules/reporter/profile_manager.py —
+        py-spy there; faulthandler-style sys._current_frames here, no
+        external tooling needed)."""
+        import traceback as _tb
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for tid, frame in frames.items():
+            parts.append(
+                f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                + "".join(_tb.format_stack(frame)))
+        return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
+                "current_task": self.current_task_id.hex()
+                if self.current_task_id else None,
+                "stacks": "\n".join(parts),
+                # actor-call ordering state: dispatched watermark and any
+                # parked out-of-order seqs per caller (a stuck parked seq
+                # here is the first thing to look for in a wedge)
+                "actor_seq": dict(self._actor_seq),
+                "parked_seqs": {c: sorted(m) for c, m in
+                                self._actor_waiting.items() if m}}
 
     async def _h_pubsub(self, payload, conn):
         """GCS pubsub push. Drivers mirror 'worker_logs' lines to their own
-        stdout/stderr (reference: log_monitor → print_logs in worker.py)."""
-        if payload.get("channel") != "worker_logs" or not self.log_to_driver:
+        stdout/stderr (reference: log_monitor → print_logs in worker.py);
+        obj:* channels wake waiters blocked on an object's location."""
+        channel = payload.get("channel") or ""
+        if channel.startswith("obj:"):
+            with self._obj_channel_lock:
+                ent = self._obj_channels.get(channel[4:])
+            if ent is not None:
+                ent[0].set()
+            return {}
+        if channel != "worker_logs" or not self.log_to_driver:
             return {}
         msg = payload.get("message") or {}
         job = msg.get("job_id")
@@ -624,7 +673,11 @@ class Worker:
         attempts = 3
         for i in range(attempts):
             try:
-                return self.plasma.create(oid, size)
+                # last attempt (spilling couldn't make room) may overflow
+                # into the disk-backed fallback segment — reference
+                # plasma's spill-then-fallback ordering
+                return self.plasma.create(
+                    oid, size, allow_fallback=(i == attempts - 1))
             except ObjectStoreFullError:
                 if self.raylet is None or i == attempts - 1:
                     raise
@@ -811,10 +864,55 @@ class Worker:
         if self._try_locations(oid):
             return True
         if self.mode == MODE_WORKER or not ref.owner_address():
-            # borrower without owner info — poll briefly
-            time.sleep(0.05)
+            # borrower without owner info: long-poll the object channel
+            # (reference: GCS pubsub object channels /
+            # WORKER_OBJECT_LOCATIONS_CHANNEL) — subscribe, re-check
+            # the directory to close the subscribe/add race, then block
+            # on the notification instead of a poll loop
+            ev = self._subscribe_object_channel(oid)
+            try:
+                if self._try_locations(oid):
+                    return True
+                ev.wait(step)
+            finally:
+                self._unsubscribe_object_channel(oid)
             return timeout is None or timeout > 0
         return self._maybe_reconstruct(oid)
+
+    def _subscribe_object_channel(self, oid: ObjectID) -> threading.Event:
+        """Subscribe to the per-object location channel; returns the
+        event its pubsub notification sets. Refcounted: concurrent
+        waiters on one object share a subscription."""
+        hex_id = oid.hex()
+        with self._obj_channel_lock:
+            ent = self._obj_channels.get(hex_id)
+            if ent is not None:
+                ent[1] += 1
+                return ent[0]
+            ev = threading.Event()
+            self._obj_channels[hex_id] = [ev, 1]
+        try:
+            self.call_sync(self.gcs, "subscribe",
+                           {"channels": [f"obj:{hex_id}"]}, timeout=10)
+        except Exception:
+            pass  # degrade to the timed wait; re-check loop still runs
+        return ev
+
+    def _unsubscribe_object_channel(self, oid: ObjectID):
+        hex_id = oid.hex()
+        with self._obj_channel_lock:
+            ent = self._obj_channels.get(hex_id)
+            if ent is None:
+                return
+            ent[1] -= 1
+            if ent[1] > 0:
+                return
+            self._obj_channels.pop(hex_id, None)
+        try:
+            self.io.run_async(self.gcs.call(
+                "unsubscribe", {"channels": [f"obj:{hex_id}"]}))
+        except Exception:
+            pass
 
     def _try_locations(self, oid: ObjectID) -> bool:
         try:
@@ -947,7 +1045,8 @@ class Worker:
                 tuple(item), {})
             task_id = TaskID.for_task(parent)
             spec = dict(shared, task_id=task_id.hex(), args=arg_blob,
-                        plasma_deps=plasma_deps, arg_refs=arg_refs)
+                        plasma_deps=plasma_deps, arg_refs=arg_refs,
+                        trace_ctx=self._trace_ctx_for_submit())
             return_ids = [ObjectID.for_return(task_id, i)
                           for i in range(num_returns)]
             state = PendingTaskState(spec, spec["max_retries"], return_ids)
@@ -964,7 +1063,29 @@ class Worker:
             self.io.run_async(self._flush_submits())
         return out
 
+    # ---- tracing: span propagation through task specs (reference:
+    # util/tracing/tracing_helper.py:160 _DictPropagator — the context
+    # rides the TaskSpec; here it lands in the chrome timeline args so
+    # `ray-tpu timeline` reconstructs the driver→task→child tree) ----
+
+    def _current_trace(self) -> Dict[str, str]:
+        ctx = getattr(self.task_context, "trace", None)
+        if ctx:
+            return ctx
+        if not hasattr(self, "_root_trace"):
+            self._root_trace = {"trace_id": os.urandom(8).hex(),
+                                "span_id": "root"}
+        return self._root_trace
+
+    def _trace_ctx_for_submit(self) -> Dict[str, str]:
+        cur = self._current_trace()
+        return {"trace_id": cur["trace_id"],
+                "span_id": os.urandom(8).hex(),
+                "parent_span_id": cur["span_id"]}
+
     def submit_spec(self, spec, reconstruction: bool = False) -> List[ObjectRef]:
+        if "trace_ctx" not in spec:
+            spec["trace_ctx"] = self._trace_ctx_for_submit()
         task_id = TaskID(bytes.fromhex(spec["task_id"]))
         num_returns = spec["num_returns"]
         return_ids = [ObjectID.for_return(task_id, i)
@@ -1263,6 +1384,9 @@ class Worker:
         app_error = False
         from ray_tpu.util import timeline as _timeline
         _t0 = time.time()
+        # adopt the propagated span: child submits from inside this task
+        # will parent to it
+        self.task_context.trace = spec.get("trace_ctx")
         try:
             if task_hex in self._cancelled_tasks:
                 raise exc.TaskCancelledError(task_hex)
@@ -1299,9 +1423,11 @@ class Worker:
                                 "inline": ser.to_bytes()})
         finally:
             self.current_task_id = None
+            self.task_context.trace = None
             _timeline.record_task(spec.get("fn_name", "task"), _t0,
                                   time.time(), pid=os.getpid(),
-                                  failed=app_error)
+                                  failed=app_error,
+                                  trace_ctx=spec.get("trace_ctx"))
         # Deliver the result BEFORE task_done: for TPU tasks the raylet
         # retires (kills) this worker as soon as task_done arrives, so a
         # fire-and-forget result here races worker death and the owner would
@@ -1400,15 +1526,94 @@ class Worker:
             return ex
         return self._actor_threads
 
+    def enqueue_actor_call(self, actor_id_hex: str, payload: Dict[str, Any],
+                           coro_factory) -> int:
+        """Stamp ``payload`` with the next per-(process, actor) sequence
+        number and enqueue its send coroutine — ATOMICALLY. All handles
+        share the counter (__reduce__-recreated handles must not restart
+        the numbering), and because run_coroutine_threadsafe preserves
+        enqueue order, holding the lock across both steps means frames
+        leave in seq order on the cached fast path; out-of-order
+        delivery then only happens on cold starts and retries, where the
+        receiver's parking backstop absorbs it."""
+        with self._actor_send_lock:
+            n = self._actor_send_seq.get(actor_id_hex, 0) + 1
+            self._actor_send_seq[actor_id_hex] = n
+            payload["seq"] = n
+            payload["processed_up_to"] = \
+                self._actor_processed_upto.get(actor_id_hex, 0)
+            self.io.run_async(coro_factory())
+            return n
+
+    def mark_actor_seq_done(self, actor_id_hex: str, seq: int):
+        """A call completed (result or error): advance the contiguous
+        processed prefix that future calls advertise."""
+        with self._actor_send_lock:
+            done = self._actor_done_seqs.setdefault(actor_id_hex, set())
+            done.add(seq)
+            upto = self._actor_processed_upto.get(actor_id_hex, 0)
+            while upto + 1 in done:
+                upto += 1
+                done.discard(upto)
+            self._actor_processed_upto[actor_id_hex] = upto
+
+    async def _order_actor_call(self, caller: str, seq: int,
+                                processed_up_to: int = 0):
+        """Park until every lower seq from this caller has been
+        dispatched (per-caller ordering — without it the async send
+        tasks race and e.g. train() can reach the actor before
+        create()). A timeout keeps a gap from wedging the queue: a
+        predecessor that died before sending (send-side failure) or a
+        counter carried across an actor restart both resolve by
+        skipping forward — best-effort beats deadlock. 30 s errs toward
+        ordering: under a saturated host a predecessor's send can lag
+        seconds, and skipping early re-creates the reorder bug."""
+        if not caller or not seq:
+            return
+        loop = asyncio.get_running_loop()
+        waiting = self._actor_waiting.setdefault(caller, {})
+        if processed_up_to > self._actor_seq.get(caller, 0):
+            # the caller says everything ≤ processed_up_to already
+            # completed (possibly against a previous incarnation of this
+            # actor): fast-forward instead of waiting on phantom gaps
+            self._actor_seq[caller] = processed_up_to
+            self._release_actor_call(caller, processed_up_to)
+        while seq > self._actor_seq.get(caller, 0) + 1:
+            fut = loop.create_future()
+            waiting[seq] = fut
+            try:
+                await asyncio.wait_for(fut, timeout=30.0)
+            except asyncio.TimeoutError:
+                break
+            finally:
+                waiting.pop(seq, None)
+        if seq > self._actor_seq.get(caller, 0):
+            self._actor_seq[caller] = seq
+
+    def _release_actor_call(self, caller: str, seq: int):
+        if not caller or not seq:
+            return
+        nxt = self._actor_waiting.get(caller, {}).get(seq + 1)
+        if nxt is not None and not nxt.done():
+            nxt.set_result(None)
+
     async def _h_actor_call(self, payload, conn):
         loop = asyncio.get_running_loop()
         method_name = payload["method"]
+        # ordering FIRST: every error path below must still consume this
+        # seq (and release its successor), or calls pipelined behind a
+        # bad one stall on a phantom gap until the parking timeout
+        await self._order_actor_call(payload.get("caller"),
+                                     payload.get("seq") or 0,
+                                     payload.get("processed_up_to") or 0)
         inst = self._actor_instance
-        if inst is None:
-            raise protocol.RpcError("not an actor worker")
-        method = getattr(inst, method_name, None)
-        if method is None:
+        method = getattr(inst, method_name, None) \
+            if inst is not None else None
+        if inst is None or method is None:
+            self._release_actor_call(payload.get("caller"),
+                                     payload.get("seq") or 0)
             raise protocol.RpcError(
+                "not an actor worker" if inst is None else
                 f"{type(inst).__name__} has no method {method_name}")
 
         def _run():
@@ -1434,6 +1639,8 @@ class Worker:
         try:
             executor = self._executor_for(method)
         except ValueError as e:
+            self._release_actor_call(payload.get("caller"),
+                                     payload.get("seq") or 0)
             # surface as an application error on the return object, not a
             # transport failure (which would look like an actor death)
             err = exc.ActorError.capture(
@@ -1443,7 +1650,12 @@ class Worker:
                 TaskID(bytes.fromhex(payload["task_id"])), 0)
             return {"object_id": oid.hex(), "inline": ser.to_bytes(),
                     "app_error": True}
-        return await loop.run_in_executor(executor, _run)
+        # enqueue BEFORE releasing the successor: the executor's FIFO
+        # queue then preserves seq order within each concurrency group
+        fut = loop.run_in_executor(executor, _run)
+        self._release_actor_call(payload.get("caller"),
+                                 payload.get("seq") or 0)
+        return await fut
 
 
 class _PlasmaIndirect:

@@ -90,9 +90,6 @@ class ActorHandle:
     def _remote_call(self, method: str, args, kwargs,
                      opts: Dict[str, Any]) -> ObjectRef:
         w = global_worker()
-        with self._lock:
-            self._seq += 1
-            seq = self._seq
         task_id = TaskID.for_task(w.current_task_id
                                   or TaskID.for_driver(w.job_id))
         # _serialize_args (not bare serialize): promotes large numpy args
@@ -107,7 +104,7 @@ class ActorHandle:
             "task_id": task_id.hex(),
             "method": method,
             "args": arg_blob,
-            "seq": seq,
+            # "seq"/"processed_up_to" are stamped at enqueue time below
             "caller": w.address,
         }
         oid = ObjectID.for_return(task_id, 0)
@@ -120,12 +117,36 @@ class ActorHandle:
 
         async def _call(attempt: int = 0):
             try:
-                addr = await _to_thread(self._resolve_address)
+                await _call_inner(attempt)
+            except BaseException as e:  # noqa: BLE001 — last resort
+                # a send task dying WITHOUT storing a result strands the
+                # caller forever (observed rarely under load); convert
+                # any leak through the structured paths below into a
+                # visible, retryable error instead
+                if not state.done:
+                    _store_actor_error(w, state, exc.ActorUnavailableError(
+                        f"actor call send task failed: "
+                        f"{type(e).__name__}: {e}"))
+                    w.mark_actor_seq_done(self._id_hex, payload["seq"])
+
+        async def _call_inner(attempt: int = 0):
+            try:
+                # cached-address fast path: no executor hop, so the task
+                # body runs straight through conn.call's synchronous
+                # write — event-loop start order (= seq order, see the
+                # enqueue below) is then the wire order, and receiver-
+                # side parking stays a cold-start/retry backstop instead
+                # of a steady-state cost
+                addr = self._worker_address
+                if addr is None:
+                    addr = await _to_thread(self._resolve_address)
                 conn = await w._peer(addr)
                 ret = await conn.call("actor_call", payload)
                 _store_actor_result(w, state, ret)
+                w.mark_actor_seq_done(self._id_hex, payload["seq"])
             except exc.ActorDiedError as e:
                 _store_actor_error(w, state, e)
+                w.mark_actor_seq_done(self._id_hex, payload["seq"])
             except Exception as e:  # connection error → maybe restart
                 self._worker_address = None
                 info = None
@@ -139,18 +160,26 @@ class ActorHandle:
                 if restartable and (self._max_task_retries == -1
                                     or attempt < max(self._max_task_retries, 0)):
                     await _to_thread(time.sleep, 0.2)
-                    await _call(attempt + 1)
+                    await _call_inner(attempt + 1)
                 elif restartable and self._max_task_retries == 0:
                     _store_actor_error(
                         w, state, exc.ActorUnavailableError(
                             f"actor {self._id_hex[:8]} restarting; call not "
                             f"retried (max_task_retries=0): {e}"))
+                    w.mark_actor_seq_done(self._id_hex, payload["seq"])
                 else:
                     reason = (info or {}).get("death_cause") or str(e)
                     _store_actor_error(
                         w, state, exc.ActorDiedError(self._id_hex, reason))
+                    w.mark_actor_seq_done(self._id_hex, payload["seq"])
 
-        w.io.run_async(_call())
+        # seq allocation and event-loop enqueue are ATOMIC: sequence
+        # numbers are per (process, actor) in caller program order, and
+        # run_coroutine_threadsafe preserves enqueue order, so with the
+        # fast path above the frames leave in seq order (reference:
+        # actor_scheduling_queue.cc per-caller ordering; the receiver
+        # parks out-of-order arrivals as the backstop)
+        seq = w.enqueue_actor_call(self._id_hex, payload, _call)
         return ObjectRef(oid, w.address)
 
 

@@ -36,6 +36,13 @@ class SystemConfig:
     max_inline_object_size: int = 100 * 1024
     object_spilling_threshold: float = 0.8
     object_store_fallback_dir: str = ""
+    # JSON spec for the spill backend (reference: object_spilling_config
+    # in ray_config_def.h + _private/external_storage.py): e.g.
+    # {"type": "smart_open", "params": {"uri_prefix": "s3://bkt/spill"}}
+    object_spilling_config: str = ""
+    # cap on in-flight inbound pull bytes as a fraction of store
+    # capacity (reference: pull_manager.cc admission under pressure)
+    pull_admission_fraction: float = 0.5
     # ---- scheduler ----
     scheduler_spread_threshold: float = 0.5
     worker_lease_timeout_s: float = 30.0

@@ -100,11 +100,18 @@ class DashboardActor:
                         text += _cluster_gauges(state)
                     except Exception:
                         pass
+                    try:
+                        text += _node_gauges(state)
+                    except Exception:
+                        pass
                     return self._text(200, text)
                 if path == "/api/cluster_status":
                     return self._json(200, state.summarize_cluster())
                 if path == "/api/nodes":
                     return self._json(200, {"nodes": state.list_nodes()})
+                if path == "/api/nodes/stats":
+                    return self._json(200,
+                                      {"nodes": state.node_stats()})
                 if path == "/api/actors":
                     return self._json(200,
                                       {"actors": state.list_actors()})
@@ -112,6 +119,12 @@ class DashboardActor:
                     return self._json(
                         200, {"placement_groups":
                               state.list_placement_groups()})
+                if path == "/api/profile/stacks":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    return self._json(200, state.profile_stacks(
+                        node_id=(q.get("node_id") or [None])[0],
+                        worker_id=(q.get("worker_id") or [None])[0]))
                 if path == "/api/events":
                     from urllib.parse import parse_qs, urlparse
                     q = parse_qs(urlparse(self.path).query)
@@ -214,6 +227,48 @@ def _cluster_gauges(state) -> str:
                 lines.append(
                     f'ray_tpu_{metric}{{resource="{k}"}} {float(v)}')
     return "\n" + "\n".join(lines) + "\n"
+
+
+def _node_gauges(state) -> str:
+    """Per-node native metric set, labeled by node (reference:
+    src/ray/stats/metric_defs.cc — ray_scheduler_tasks,
+    ray_object_store_*, ray_spill_manager_*, and the reporter agent's
+    node_cpu/node_mem gauges), scraped live from each raylet agent."""
+    lines = []
+    seen_help = set()
+
+    def g(name, node, value, help_):
+        full = f"ray_tpu_node_{name}"
+        if full not in seen_help:
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+            seen_help.add(full)
+        lines.append(f'{full}{{node="{node}"}} {float(value)}')
+
+    for n in state.node_stats():
+        if "error" in n:
+            continue
+        nid = n["node_id"][:16]
+        for k, v in n.get("physical", {}).items():
+            g(k, nid, v, f"host {k.replace('_', ' ')}")
+        sched = n.get("scheduler", {})
+        for k in ("tasks_pending", "tasks_running",
+                  "tasks_dispatched_total", "tasks_spilled_back_total",
+                  "workers_alive", "workers_idle", "actors_alive"):
+            g(f"scheduler_{k}", nid, sched.get(k, 0), f"scheduler {k}")
+        for res, v in (sched.get("resources_available") or {}).items():
+            if isinstance(v, (int, float)):
+                lines.append(
+                    f'ray_tpu_node_resource_available'
+                    f'{{node="{nid}",resource="{res}"}} {float(v)}')
+        store = n.get("object_store", {})
+        for k, v in store.items():
+            if isinstance(v, (int, float)):
+                g(f"object_store_{k}", nid, v, f"object store {k}")
+        tpu = n.get("tpu", {})
+        for k in ("num_chips", "chips_available"):
+            g(f"tpu_{k}", nid, tpu.get(k, 0), f"TPU {k}")
+    return "\n" + "\n".join(lines) + "\n" if lines else ""
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:

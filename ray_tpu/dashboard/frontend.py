@@ -80,11 +80,24 @@ async function refresh() {
       </div>`).join("");
 
     const nodes = (await get("/api/nodes")).nodes || [];
+    const stats = (await get("/api/nodes/stats")).nodes || [];
+    const byId = Object.fromEntries(stats.map(s => [s.node_id, s]));
+    const gb = b => (b / 1e9).toFixed(1) + "G";
     document.getElementById("nodes").innerHTML =
-      head(["node", "alive", "resources", "available"]) +
-      nodes.map(n => row([n.node_id.slice(0, 12), n.alive,
-        JSON.stringify(n.resources), JSON.stringify(n.available)]))
-        .join("");
+      head(["node", "alive", "cpu%", "mem free", "store used",
+            "tasks p/r", "workers", "spilled", "resources"]) +
+      nodes.map(n => { const s = byId[n.node_id] || {};
+        const p = s.physical || {}, sc = s.scheduler || {},
+              os_ = s.object_store || {};
+        return row([n.node_id.slice(0, 12), n.alive,
+          p.cpu_percent != null ? p.cpu_percent.toFixed(0) : "-",
+          p.mem_available_bytes != null ? gb(p.mem_available_bytes) : "-",
+          os_.used_bytes != null ?
+            gb(os_.used_bytes) + "/" + gb(os_.capacity) : "-",
+          (sc.tasks_pending ?? "-") + "/" + (sc.tasks_running ?? "-"),
+          sc.workers_alive ?? "-",
+          os_.spilled_objects ?? "-",
+          JSON.stringify(n.resources)]); }).join("");
 
     const actors = (await get("/api/actors")).actors || [];
     document.getElementById("actors").innerHTML =

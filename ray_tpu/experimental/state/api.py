@@ -29,6 +29,22 @@ def list_actors(filters: Optional[Dict[str, Any]] = None
     return _apply_filters(_gcs_call("list_actors"), filters)
 
 
+def profile_stacks(node_id: Optional[str] = None,
+                   worker_id: Optional[str] = None) -> Dict[str, Any]:
+    """Live stack snapshot of workers (reference:
+    dashboard/modules/reporter/profile_manager.py — on-demand worker
+    profiling; faulthandler-style dumps here)."""
+    return _gcs_call("profile_stacks",
+                     {"node_id": node_id, "worker_id": worker_id})
+
+
+def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Per-node agent snapshots: physical cpu/mem/disk plus the native
+    scheduler/object-store gauges (reference: dashboard/agent.py
+    reporter + src/ray/stats/metric_defs.cc)."""
+    return _gcs_call("get_node_stats", {"node_id": node_id})["nodes"]
+
+
 def list_jobs(filters: Optional[Dict[str, Any]] = None
               ) -> List[Dict[str, Any]]:
     return _apply_filters(_gcs_call("get_jobs"), filters)

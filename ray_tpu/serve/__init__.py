@@ -12,6 +12,7 @@ from ray_tpu.serve.api import (Application, Deployment, delete,
                                run, shutdown, start, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.ingress import APIRouter, ingress
 from ray_tpu.serve._private.autoscaling import AutoscalingConfig
 
 __all__ = [
@@ -19,4 +20,5 @@ __all__ = [
     "delete_application", "list_applications",
     "get_deployment_handle", "Deployment", "Application",
     "DeploymentHandle", "batch", "AutoscalingConfig",
+    "APIRouter", "ingress",
 ]

@@ -110,7 +110,12 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 "autoscaling_config": autoscaling_config,
                 "ray_actor_options": ray_actor_options,
                 "route_prefix": route_prefix,
-                "pass_http_path": pass_http_path,
+                # @serve.ingress classes (serve/ingress.py) opt into the
+                # proxy's path+method passing via class attributes
+                "pass_http_path": pass_http_path or bool(getattr(
+                    func_or_class, "__serve_pass_http_path__", False)),
+                "pass_http_method": bool(getattr(
+                    func_or_class, "__serve_pass_http_method__", False)),
             })
 
     return wrap if _func_or_class is None else wrap(_func_or_class)

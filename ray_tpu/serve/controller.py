@@ -262,6 +262,8 @@ class ServeController:
                     "route_prefix": info.config.get("route_prefix"),
                     "pass_http_path":
                         bool(info.config.get("pass_http_path")),
+                    "pass_http_method":
+                        bool(info.config.get("pass_http_method")),
                 }
         self._long_poll.notify_changed("route_table", table)
 

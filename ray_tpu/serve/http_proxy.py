@@ -37,6 +37,8 @@ class HTTPProxyActor:
     def _on_route_update(self, table):
         self._pass_path = {name: bool(info.get("pass_http_path"))
                            for name, info in (table or {}).items()}
+        self._pass_method = {name: bool(info.get("pass_http_method"))
+                             for name, info in (table or {}).items()}
         routes = {}
         for name, info in (table or {}).items():
             prefix = info.get("route_prefix")
@@ -112,6 +114,9 @@ class HTTPProxyActor:
                                     sub.startswith(route_prefix):
                                 sub = sub[len(route_prefix):] or "/"
                             kwargs["__serve_path__"] = sub
+                            if getattr(proxy, "_pass_method",
+                                       {}).get(name):
+                                kwargs["__serve_method__"] = self.command
                         ref, release = proxy._router.assign_request(
                             name, "__call__",
                             (payload,) if payload is not None else (),
@@ -120,6 +125,14 @@ class HTTPProxyActor:
                             result = ray_tpu.get(ref, timeout=60.0)
                         finally:
                             release()
+                        if isinstance(result, dict) and \
+                                "__serve_http_status__" in result:
+                            # structured routing miss from an ingress
+                            # deployment (serve/ingress.py)
+                            self._respond(
+                                result["__serve_http_status__"],
+                                {"error": result.get("error")})
+                            return
                         self._respond(200, result)
                         return
                     except (rexc.ActorDiedError,

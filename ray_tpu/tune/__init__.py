@@ -10,11 +10,12 @@ from ray_tpu.tune.sample import (  # noqa: F401
     uniform)
 from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
-    BasicVariantGenerator, BayesOptSearch, ConcurrencyLimiter,
-    HyperOptSearch, OptunaSearch, RandomSearch, Searcher, TPESearcher)
+    BasicVariantGenerator, BayesOptSearch, BOHBSearcher,
+    ConcurrencyLimiter, HyperOptSearch, OptunaSearch, RandomSearch,
+    Searcher, TPESearcher)
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    HyperBandScheduler, MedianStoppingRule, PB2,
+    HyperBandForBOHB, HyperBandScheduler, MedianStoppingRule, PB2,
     PopulationBasedTraining, ResourceChangingScheduler, TrialScheduler)
 from ray_tpu.tune.logger import (  # noqa: F401
     Callback, CSVLoggerCallback, JsonLoggerCallback, LoggerCallback,

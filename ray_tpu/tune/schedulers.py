@@ -155,6 +155,15 @@ class HyperBandScheduler(TrialScheduler):
             runner, trial, result)
 
 
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant paired with BOHBSearcher (reference:
+    schedulers/hb_bohb.py). The bracket ladder is the async HyperBand
+    above; BOHB's coupling lives in the SEARCHER (its KDE conditions on
+    per-budget results arriving from these brackets), so this subclass
+    exists as the documented pairing point and keeps the reference's
+    class name."""
+
+
 class ResourceChangingScheduler(TrialScheduler):
     """Wraps a base scheduler and reallocates per-trial resources while
     trials run (reference: schedulers/resource_changing_scheduler.py).

@@ -11,6 +11,7 @@ from ray_tpu.tune.search.searcher import (  # noqa: F401
     PENDING, ConcurrencyLimiter, Searcher)
 from ray_tpu.tune.search.basic_variant import (  # noqa: F401
     BasicVariantGenerator, RandomSearch)
+from ray_tpu.tune.search.bohb import BOHBSearcher  # noqa: F401
 from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
 from ray_tpu.tune.search.bayesopt import BayesOptSearch  # noqa: F401
 from ray_tpu.tune.search.external import (  # noqa: F401

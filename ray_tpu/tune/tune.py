@@ -238,10 +238,22 @@ class TrialRunner:
         cfg["__trial_name__"] = trial.trial_name
         if checkpoint is not None:
             cfg["__checkpoint__"] = checkpoint
-        ray_tpu.get(trial.actor.create.remote(
-            self.trainable_cls, cfg, len(trial.results)))
+        # NO blocking gets here: per-actor call ordering sequences
+        # create -> restore -> train on the actor, and the actor itself
+        # may still be PENDING_CREATION behind running trials' resources.
+        # A synchronous wait at this point deadlocks the runner: it can
+        # never process the running trials' results, so the resources the
+        # pending actor needs are never released (observed as a hang the
+        # moment trials exceed cluster CPUs with prestarted workers).
+        setup_refs = [trial.actor.create.remote(
+            self.trainable_cls, cfg, len(trial.results))]
         if checkpoint is not None:
-            ray_tpu.get(trial.actor.restore.remote(checkpoint))
+            setup_refs.append(trial.actor.restore.remote(checkpoint))
+        # checked when train's first result lands (_check_setup_refs):
+        # by per-actor ordering they are resolved by then, so a failed
+        # restore surfaces as a trial failure instead of silently
+        # training from scratch
+        trial.setup_refs = setup_refs
         trial.status = RUNNING
         trial.future = trial.actor.train.remote()
         for cb in self.callbacks:
@@ -282,7 +294,23 @@ class TrialRunner:
         if ckpt is not None:
             trial.ckpt_manager.add(ckpt, result)
 
+    def _check_setup_refs(self, trial: Trial) -> bool:
+        """Surface create/restore errors once train has produced its
+        first signal (actor ordering guarantees they resolved first).
+        True = setup was clean."""
+        refs, trial.setup_refs = getattr(trial, "setup_refs", None), None
+        if not refs:
+            return True
+        try:
+            ray_tpu.get(refs, timeout=10)
+            return True
+        except Exception as e:
+            self._process_failure(trial, e)
+            return False
+
     def _process_result(self, trial: Trial, result: Dict[str, Any]):
+        if not self._check_setup_refs(trial):
+            return
         auto_keys = {DONE, TRAINING_ITERATION, "time_total_s",
                      "__checkpoint__"}
         if result.get(DONE) and not (set(result) - auto_keys):

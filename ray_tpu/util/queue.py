@@ -93,6 +93,11 @@ class Queue:
             if deadline is not None:
                 slice_t = min(slice_t, max(0.0, deadline -
                                            time.monotonic()))
+            # unbounded inner get is safe: the actor transport guarantees
+            # a reply (result or ActorUnavailableError — the send task's
+            # last-resort handler) — and a timeout here would DROP an
+            # item the actor already dequeued when the reply is merely
+            # slow under a backlog
             ok, item = ray_tpu.get(self.actor.get.remote(slice_t))
             if ok:
                 return item

@@ -53,9 +53,12 @@ def record(name, ph, ts, pid=0, tid=0, **kw):
 
 
 def record_task(name: str, t0: float, t1: float, pid: int = 0,
-                failed: bool = False):
+                failed: bool = False, trace_ctx: Dict[str, Any] = None):
     """Complete ('X') event per task execution; flushed opportunistically
-    to the GCS so the driver can merge cross-process."""
+    to the GCS so the driver can merge cross-process. ``trace_ctx``
+    carries the propagated span identifiers (reference:
+    tracing_helper.py _DictPropagator riding the TaskSpec) so the merged
+    timeline reconstructs the driver→task→child call tree."""
     with _lock:
         _events.append({
             "name": name, "ph": "X", "ts": t0 * 1e6,
@@ -63,6 +66,7 @@ def record_task(name: str, t0: float, t1: float, pid: int = 0,
             "tid": threading.get_ident() % 1_000_000,
             "cname": "terrible" if failed else None,
             "cat": "task",
+            "args": dict(trace_ctx or {}),
         })
         global _total_recorded
         _total_recorded += 1

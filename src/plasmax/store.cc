@@ -269,6 +269,20 @@ int evict_for(void* base, uint64_t need) {
 
 extern "C" {
 
+// TEST HOOK: acquire the segment mutex and return WITHOUT releasing it.
+// Lets a test process die while holding the lock, so the robust-mutex
+// EOWNERDEAD recovery path (Locker above) can be exercised
+// deterministically from the crash-recovery test suite.
+int px_debug_lock(void* base) {
+  Header* h = static_cast<Header*>(base);
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    return 1;
+  }
+  return rc == 0 ? 0 : -1;
+}
+
 // Returns required segment size for a given heap capacity + slot count.
 uint64_t px_segment_size(uint64_t heap_bytes, uint32_t nslots) {
   return round_up(sizeof(Header) + sizeof(Slot) * nslots, kAlign) +

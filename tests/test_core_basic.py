@@ -240,3 +240,29 @@ def test_gc_during_refcount_no_deadlock(ray_start_shared):
     w.reference_counter.drain_deferred()
     remaining = len(w.reference_counter.table)
     assert remaining < 50, f"refcount table leaked: {remaining} entries"
+
+
+def test_pipelined_actor_calls_execute_in_order(ray_start_shared):
+    """Per-caller actor ordering (reference: actor_scheduling_queue.cc):
+    fire-and-forget calls must execute in submission order even though
+    their async sends race — create-then-train style pipelining depends
+    on it."""
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, i):
+            self.seen.append(i)
+            return i
+
+        def dump(self):
+            return list(self.seen)
+
+    for _ in range(5):  # the race was intermittent — several rounds
+        log = Log.remote()
+        for i in range(20):
+            log.add.remote(i)  # no gets: sends race on the event loop
+        assert ray_tpu.get(log.dump.remote(),
+                           timeout=60) == list(range(20))
+        ray_tpu.kill(log)

@@ -113,3 +113,73 @@ def test_dashboard_frontend_page(cluster):
     assert "<!doctype html>" in html
     assert "/api/cluster_status" in html
     assert "ray_tpu" in html
+
+
+def test_node_stats_agent(cluster):
+    """Per-node agent snapshot (reference: dashboard/agent.py reporter
+    + metric_defs.cc native gauges) carries physical + scheduler +
+    object-store readings that move under load."""
+    @ray_tpu.remote
+    def burn(x):
+        return bytes(2 * 1024 * 1024)  # forces plasma traffic
+
+    refs = [burn.remote(i) for i in range(20)]
+    ray_tpu.get(refs)
+    state.node_stats()  # prime the cpu_percent delta sample
+    time.sleep(0.5)     # the delta needs ticks between the two reads
+    stats = state.node_stats()
+    assert len(stats) == 1
+    s = stats[0]
+    assert s["physical"]["mem_total_bytes"] > 0
+    assert s["physical"]["mem_available_bytes"] > 0
+    assert "cpu_percent" in s["physical"]
+    assert s["physical"]["disk_free_bytes"] > 0
+    sched = s["scheduler"]
+    assert sched["tasks_dispatched_total"] >= 20
+    assert sched["workers_alive"] >= 1
+    assert sched["resources_total"]["CPU"] == 4.0
+    store = s["object_store"]
+    assert store["capacity"] > 0
+    assert store["num_created"] >= 20
+    for key in ("used_bytes", "spilled_objects", "spill_count_total",
+                "restored_bytes_total", "pull_inflight_bytes",
+                "pushes_inflight", "pinned_objects"):
+        assert key in store, key
+    del refs
+
+
+def test_node_stats_in_prometheus_and_api(cluster):
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    port = start_dashboard(port=18265)
+
+    def get(path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30).read()
+
+    doc = json.loads(get("/api/nodes/stats"))
+    assert doc["nodes"] and "scheduler" in doc["nodes"][0]
+    metrics = get("/metrics").decode()
+    for gauge in (
+            "ray_tpu_node_mem_total_bytes",
+            "ray_tpu_node_mem_available_bytes",
+            "ray_tpu_node_disk_free_bytes",
+            "ray_tpu_node_scheduler_tasks_pending",
+            "ray_tpu_node_scheduler_tasks_running",
+            "ray_tpu_node_scheduler_tasks_dispatched_total",
+            "ray_tpu_node_scheduler_tasks_spilled_back_total",
+            "ray_tpu_node_scheduler_workers_alive",
+            "ray_tpu_node_scheduler_workers_idle",
+            "ray_tpu_node_scheduler_actors_alive",
+            "ray_tpu_node_resource_available",
+            "ray_tpu_node_object_store_used_bytes",
+            "ray_tpu_node_object_store_capacity",
+            "ray_tpu_node_object_store_num_objects",
+            "ray_tpu_node_object_store_num_created",
+            "ray_tpu_node_object_store_num_evicted",
+            "ray_tpu_node_object_store_spilled_objects",
+            "ray_tpu_node_object_store_spill_count_total",
+            "ray_tpu_node_object_store_pull_inflight_bytes",
+            "ray_tpu_node_tpu_num_chips",
+    ):
+        assert gauge in metrics, gauge
+    assert 'node="' in metrics

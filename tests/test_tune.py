@@ -135,3 +135,20 @@ def test_pbt_exploit(cluster):
     weak = [t for t in analysis.trials if t.config.get("lr") != 1.0]
     if weak:  # config may have been mutated away from 0.01
         assert weak[0].last_result["score"] > 0.2
+
+
+def test_trials_exceed_cluster_cpus(cluster):
+    """Regression: _start_trial used to block on ray_tpu.get(create),
+    deadlocking the runner the moment pending trials exceeded free CPUs
+    (the pending actor's resources are held by running trials whose
+    results only the blocked runner can process)."""
+    def train_fn(config):
+        for i in range(3):
+            session.report({"score": config["x"] * (i + 1)})
+
+    analysis = tune.run(train_fn,
+                        config={"x": tune.grid_search(list(range(1, 11)))},
+                        metric="score", mode="max", verbose=0)
+    assert len(analysis.trials) == 10
+    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    assert analysis.get_best_trial().last_result["score"] == 30
