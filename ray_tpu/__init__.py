@@ -153,6 +153,10 @@ def init(address: Optional[str] = None, *,
             "job_id": w.job_id.hex(),
             "namespace": namespace,
         }
+        from ray_tpu._private import usage as _usage
+        _usage.write_report(w.session_dir,
+                            {"node_id": w.node_id,
+                             "namespace": namespace})
         atexit.register(shutdown)
         return w.runtime_context
 

@@ -156,7 +156,7 @@ class GcsServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._load_persisted()
         self.port = await self._server.start_tcp(host, port)
-        asyncio.get_running_loop().create_task(self._health_loop())
+        protocol.spawn(self._health_loop())
         self._resume_interrupted()
         logger.info("GCS listening on %s:%s", host, self.port)
         return self.port
@@ -200,13 +200,13 @@ class GcsServer:
         """Re-kick scheduling work that was in flight when the GCS died.
         Called once the server is accepting raylet re-registrations."""
         for aid in getattr(self, "_pending_restart_actors", []):
-            asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+            protocol.spawn(self._schedule_actor(aid))
         for pg_id in getattr(self, "_pending_restart_pgs", []):
-            asyncio.get_running_loop().create_task(self._retry_pg(pg_id))
+            protocol.spawn(self._retry_pg(pg_id))
         self._pending_restart_actors = []
         self._pending_restart_pgs = []
         if self.actors or self.placement_groups:
-            asyncio.get_running_loop().create_task(
+            protocol.spawn(
                 self._reconcile_after_restart())
 
     async def _reconcile_after_restart(self):
@@ -227,7 +227,7 @@ class GcsServer:
                 pg["state"] = "PENDING"
                 pg["assignment"] = None
                 self._persist_pg(pg_id)
-                asyncio.get_running_loop().create_task(self._retry_pg(pg_id))
+                protocol.spawn(self._retry_pg(pg_id))
 
     def _persist_actor(self, aid: str):
         info = self.actors.get(aid)
@@ -608,7 +608,7 @@ class GcsServer:
             return {}
         info["create_spec"] = payload.get("create_spec", info.get("create_spec"))
         self._persist_actor(aid)
-        asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+        protocol.spawn(self._schedule_actor(aid))
         return {}
 
     async def _schedule_actor(self, aid: str):
@@ -672,7 +672,7 @@ class GcsServer:
             self._persist_actor(aid)
             await self._publish("actor_events",
                                 {"actor_id": aid, "state": RESTARTING})
-            asyncio.get_running_loop().create_task(self._schedule_actor(aid))
+            protocol.spawn(self._schedule_actor(aid))
         else:
             await self._mark_actor_dead(aid, reason)
 
@@ -933,7 +933,7 @@ class GcsServer:
                 "name": payload.get("name"),
             }
             self._persist_pg(pg_id)
-            asyncio.get_running_loop().create_task(
+            protocol.spawn(
                 self._retry_pg(pg_id))
             return {"state": "PENDING"}
 

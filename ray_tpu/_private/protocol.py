@@ -47,6 +47,21 @@ class RpcError(Exception):
     pass
 
 
+_BG_TASKS: set = set()
+
+
+def spawn(coro) -> "asyncio.Task":
+    """``create_task`` with a strong reference held until completion.
+    The loop only weak-refs tasks; a discarded handle lets the GC close
+    the coroutine mid-await (GeneratorExit) — fire-and-forget work must
+    go through here (or EventLoopThread.run_async, which does the
+    same)."""
+    task = asyncio.get_running_loop().create_task(coro)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_BG_TASKS.discard)
+    return task
+
+
 class Connection:
     """A bidirectional RPC connection. Either side can issue requests."""
 
@@ -72,11 +87,9 @@ class Connection:
                 frame = await read_frame(self.reader)
                 mtype, seq, method, payload = frame
                 if mtype == REQUEST:
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(seq, method, payload))
+                    spawn(self._dispatch(seq, method, payload))
                 elif mtype == NOTIFY:
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(None, method, payload))
+                    spawn(self._dispatch(None, method, payload))
                 elif mtype in (REPLY, ERROR):
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
@@ -161,12 +174,27 @@ class Server:
         self.connections.discard(conn)
         cb = self.handlers.get("_on_disconnect")
         if cb is not None:
-            asyncio.get_event_loop().create_task(cb(conn))
+            spawn(cb(conn))
 
     async def _handle(self, method, payload, conn):
+        if method == "__hello__":
+            # version negotiation (schema.py — the protobuf-package
+            # role): reply with our version + schema hash; reject
+            # incompatible majors so drift fails at connect, not mid-RPC
+            from ray_tpu._private import schema
+            err = schema.check_hello(payload or {})
+            if err:
+                raise RpcError(f"protocol negotiation failed: {err}")
+            return schema.hello_payload()
         fn = self.handlers.get(method)
         if fn is None:
             raise RpcError(f"no such method: {method}")
+        from ray_tpu._private import schema
+        if schema.validation_enabled():
+            errors = schema.validate(method, payload)
+            if errors:
+                raise RpcError("wire schema violation: "
+                               + "; ".join(errors))
         return await fn(payload, conn)
 
     async def start_unix(self, path: str):
@@ -241,6 +269,23 @@ class ReconnectingConnection:
                             f"cannot reach {self.address}")
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 1.0)
+            # version negotiation on the long-lived links (schema.py):
+            # an incompatible MAJOR fails here, at connect time. A peer
+            # predating __hello__ replies "no such method" — compatible.
+            try:
+                from ray_tpu._private import schema
+                await self._conn.call("__hello__",
+                                      schema.hello_payload(),
+                                      timeout=10)
+            except RpcError as e:
+                if "negotiation failed" in str(e):
+                    self._conn.close()
+                    self._conn = None
+                    raise ConnectionError(
+                        f"protocol negotiation with {self.address} "
+                        f"failed: {e}")
+            except Exception:
+                pass  # hello is best-effort beyond the version check
             if not first and self.on_reconnect is not None:
                 await self.on_reconnect(self._conn)
             return self._conn
@@ -285,6 +330,7 @@ class EventLoopThread:
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._started = threading.Event()
+        self._inflight: set = set()  # strong refs to fire-and-forget tasks
         self._thread.start()
         self._started.wait()
 
@@ -299,7 +345,15 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def run_async(self, coro):
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+        """Fire-and-forget — but with a STRONG reference held until
+        completion: the event loop only weak-refs its tasks, so a
+        discarded future lets the GC close the coroutine mid-await
+        (observed as GeneratorExit killing in-flight actor-call sends
+        under allocation pressure)."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        self._inflight.add(fut)
+        fut.add_done_callback(self._inflight.discard)
+        return fut
 
     def stop(self):
         self.loop.call_soon_threadsafe(self.loop.stop)

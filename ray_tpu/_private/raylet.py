@@ -446,16 +446,16 @@ class Raylet:
         reply = await self.gcs.call("register_node", self._register_payload())
         self.config = SystemConfig.from_json(reply["config"])
         loop = asyncio.get_running_loop()
-        loop.create_task(self._dispatch_loop())
-        loop.create_task(self._report_loop())
-        loop.create_task(self._idle_reaper_loop())
-        loop.create_task(self._log_monitor_loop())
+        protocol.spawn(self._dispatch_loop())
+        protocol.spawn(self._report_loop())
+        protocol.spawn(self._idle_reaper_loop())
+        protocol.spawn(self._log_monitor_loop())
         if self.config.memory_monitor_enabled:
-            loop.create_task(self._memory_monitor_loop())
+            protocol.spawn(self._memory_monitor_loop())
         if self.config.prestart_workers:
             n = int(self.total_resources.get("CPU", 1))
             for _ in range(max(1, min(n, 4))):
-                loop.create_task(self._start_worker("", ()))
+                protocol.spawn(self._start_worker("", ()))
         logger.info("raylet %s up at %s (resources=%s)",
                     self.node_id[:8], self.address, self.total_resources)
 
@@ -663,7 +663,7 @@ class Raylet:
         def _notify(method, payload):
             payload["source"] = "raylet"
             if self.gcs is not None:
-                asyncio.get_running_loop().create_task(
+                protocol.spawn(
                     self.gcs.notify(method, payload))
 
         ev.report(severity, label, message, gcs_notify=_notify, **fields)
@@ -703,7 +703,7 @@ class Raylet:
                     owner = ptask.spec.get("owner_address")
                     task_id = ptask.spec.get("task_id")
                     if owner and task_id:
-                        asyncio.get_running_loop().create_task(
+                        protocol.spawn(
                             self._notify_owner_task_failed(
                                 owner, task_id, msg))
         if handle.is_actor and handle.actor_id and self.gcs is not None:
@@ -899,7 +899,7 @@ class Raylet:
                                           {"task_id": task_id, **reply})
                     except Exception:
                         pass  # owner-side on_close handles a dead conn
-                loop.create_task(_notify())
+                protocol.spawn(_notify())
 
             fut.add_done_callback(_on_done)
             if self._infeasible(ptask) or spec.get("spilled_from") or \
@@ -916,7 +916,7 @@ class Raylet:
                         return
                     self.pending.append(pt)
                     self._dispatch_event.set()
-                loop.create_task(_spill())
+                protocol.spawn(_spill())
             else:
                 self.pending.append(ptask)
             accepted += 1
@@ -954,7 +954,7 @@ class Raylet:
         for d in spec.get("plasma_deps") or []:
             doid = ObjectID.from_hex(d)
             if self.store.contains(doid):
-                loop.create_task(self.push_object(
+                protocol.spawn(self.push_object(
                     doid, r["raylet_address"], nid))
         try:
             remote = await self._raylet_peer(r["raylet_address"])
@@ -998,14 +998,14 @@ class Raylet:
                                 not ptask.spec.get("spilled_from") and \
                                 not ptask.spec.get("placement_group"):
                             self._spilling_classes.add(cls)
-                            asyncio.get_running_loop().create_task(
+                            protocol.spawn(
                                 self._spillback_class(cls))
                         break
                     chips = self._acquire_resources(ptask)
                     if chips is None:
                         break
                     self.pending.popleft_from(q)
-                    asyncio.get_running_loop().create_task(
+                    protocol.spawn(
                         self._dispatch(ptask, chips))
 
     async def _spillback_class(self, cls):
@@ -1590,7 +1590,7 @@ class Raylet:
         cap = self.store.capacity()
         if cap and self.store.used_bytes() > \
                 self.config.object_spilling_threshold * cap:
-            asyncio.get_running_loop().create_task(self._spill_until(0))
+            protocol.spawn(self._spill_until(0))
 
     def _get_spill_lock(self) -> asyncio.Lock:
         if self._spill_lock is None:
@@ -2000,7 +2000,7 @@ class Raylet:
             self._report_pending = False
             await self._send_report()
         try:
-            asyncio.get_running_loop().create_task(_go())
+            protocol.spawn(_go())
         except RuntimeError:
             self._report_pending = False
 

@@ -1,0 +1,232 @@
+"""Versioned wire schema for the control-plane RPC surface.
+
+Role-equivalent to the reference's ``src/ray/protobuf/`` (21 ``.proto``
+files: gcs_service.proto 43 rpcs, node_manager.proto 23,
+core_worker.proto 20, …). The transport here is msgpack, so the schema
+is declarative Python instead of protoc codegen — but it serves the
+same two contracts:
+
+1. **Versioning.** ``PROTOCOL_VERSION`` plus a content hash of the
+   schema table ride the ``__hello__`` negotiation (protocol.py). A
+   peer from a different major version is rejected at connect time
+   instead of failing obscurely mid-RPC.
+2. **Message shape.** Every field of the core RPC payloads is declared
+   with a type and requiredness. ``validate()`` enforces the table;
+   servers run it on every inbound request when
+   ``RTPU_VALIDATE_WIRE=1`` (tests enable this so schema drift is
+   caught the moment a handler grows an undeclared field).
+
+Unknown fields are ALLOWED (forward compatibility — new minor versions
+add fields; old peers ignore them), exactly the proto3 rule.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import numbers
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+# Major bumps = incompatible framing/semantics; minor bumps = added
+# methods/fields (compatible both ways).
+PROTOCOL_VERSION = (1, 0)
+
+_str = str
+_num = numbers.Number
+_int = numbers.Integral
+_bool = (bool, numbers.Integral)
+_dict = dict
+_list = (list, tuple)
+_bytes = (bytes, bytearray, memoryview)
+_any = object
+
+# method -> {field: (type, required)}. Covers the compat-critical
+# surface: node lifecycle + sync stream, scheduling, task/actor
+# submission, the object plane, KV, and pubsub. Handler-local or
+# purely-internal methods may be absent — validate() passes unknown
+# methods through (the proto3 unknown-message stance).
+SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
+    # ---- GCS: node lifecycle + versioned sync (ray_syncer.proto role)
+    "register_node": {
+        "node_id": (_str, True),
+        "raylet_address": (_str, True),
+        "object_store_path": (_str, True),
+        "resources": (_dict, True),
+        "labels": (_dict, False),
+        "tpu": (_dict, False),
+        "hostname": (_str, False),
+        "is_head": (_bool, False),
+        "objects": (_list, False),
+        "sync_epoch": (_num, False),
+        "sync_version": (_int, False),
+    },
+    "resource_report": {
+        "node_id": (_str, True),
+        "available": (_dict, True),
+        "total": (_dict, False),
+        "sync_epoch": (_num, False),
+        "sync_version": (_int, False),
+        "known_view": (_int, False),
+    },
+    "drain_node": {"node_id": (_str, True)},
+    "get_node_stats": {"node_id": (_str, False)},
+    "profile_stacks": {"node_id": (_str, False),
+                       "worker_id": (_str, False)},
+    # ---- GCS: scheduling (gcs_service.proto scheduling rpcs role)
+    "schedule": {
+        "demand": (_dict, True),
+        "scheduling": (_dict, False),
+        "deps": (_list, False),
+    },
+    # ---- GCS: actors (gcs_service.proto ActorInfoGcsService role)
+    "register_actor": {
+        "actor_id": (_str, True),
+        "class_name": (_str, False),
+        "demand": (_dict, False),
+        "name": (_str, False),
+        "namespace": (_str, False),
+        "lifetime": (_str, False),
+        "max_restarts": (_int, False),
+        "owner": (_str, False),
+        "runtime_env": (_dict, False),
+        "scheduling": (_dict, False),
+        "max_concurrency": (_int, False),
+        "concurrency_groups": (_dict, False),
+    },
+    "get_actor": {"actor_id": (_str, True)},
+    "wait_actor_alive": {"actor_id": (_str, True),
+                         "timeout": (_num, False)},
+    "kill_actor": {"actor_id": (_str, True),
+                   "no_restart": (_bool, False)},
+    # ---- GCS: placement groups (node_manager.proto 2-phase rpcs role)
+    "create_placement_group": {
+        "pg_id": (_str, True),
+        "bundles": (_list, True),
+        "strategy": (_str, False),
+        "name": (_str, False),
+        "owner": (_str, False),
+    },
+    "remove_placement_group": {"pg_id": (_str, True)},
+    # ---- GCS: KV + pubsub (gcs_kv_manager / pubsub.proto role)
+    "kv_put": {"key": (_any, True), "value": (_any, True),
+               "overwrite": (_bool, False)},
+    "kv_get": {"key": (_any, True)},
+    "kv_del": {"key": (_any, True)},
+    "kv_keys": {"prefix": (_any, False)},
+    "kv_exists": {"key": (_any, True)},
+    "subscribe": {"channels": (_list, True)},
+    "unsubscribe": {"channels": (_list, True)},
+    "publish": {"channel": (_str, True), "message": (_any, True)},
+    # ---- GCS: object directory (object_manager.proto role)
+    "add_object_location": {"object_id": (_str, True),
+                            "node_id": (_str, True),
+                            "owner": (_str, False)},
+    "remove_object_location": {"object_id": (_str, True),
+                               "node_id": (_str, True)},
+    "get_object_locations": {"object_id": (_str, True)},
+    # ---- raylet: task submission (node_manager.proto role)
+    "submit_task": {
+        "task_id": (_str, True),
+        "fn_name": (_str, False),
+        "args": (_bytes, False),
+        "demand": (_dict, False),
+        "num_returns": (_int, False),
+        "max_retries": (_int, False),
+        "retry_exceptions": (_bool, False),
+        "runtime_env": (_dict, False),
+        "scheduling": (_dict, False),
+        "plasma_deps": (_list, False),
+        "arg_refs": (_list, False),
+        "spilled_from": (_str, False),
+        "owner": (_str, False),
+        "job_id": (_str, False),
+        "trace_ctx": (_dict, False),
+    },
+    "submit_task_batch": {"specs": (_list, True)},
+    "task_done": {"task_id": (_str, True)},
+    "cancel_task": {"task_id": (_str, True)},
+    "request_spill": {"bytes_needed": (_int, False)},
+    # ---- raylet: object plane (object_manager.proto role)
+    "pull_object": {"object_id": (_str, True), "offset": (_int, True),
+                    "length": (_int, True)},
+    "receive_push": {"object_id": (_str, True), "offset": (_int, True),
+                     "total_size": (_int, True), "data": (_bytes, True)},
+    "fetch_object": {"object_id": (_str, True)},
+    "pin_object": {"object_id": (_str, True), "owner": (_str, False)},
+    "contains_object": {"object_id": (_str, True)},
+    "free_objects": {"object_ids": (_list, True)},
+    # ---- worker: direct actor transport (core_worker.proto role)
+    "actor_call": {
+        "task_id": (_str, True),
+        "method": (_str, True),
+        "args": (_bytes, False),
+        "seq": (_int, False),
+        "processed_up_to": (_int, False),
+        "caller": (_str, False),
+    },
+    "dump_stacks": {},
+    "node_stats": {},
+    "dump_worker_stacks": {"worker_id": (_str, False)},
+}
+
+
+def schema_hash() -> str:
+    """Content hash of the schema table (drift detector for hello)."""
+    items = []
+    for method in sorted(SCHEMAS):
+        for field in sorted(SCHEMAS[method]):
+            t, req = SCHEMAS[method][field]
+            items.append(f"{method}.{field}:{t}:{req}")
+    return hashlib.sha1("|".join(items).encode()).hexdigest()[:16]
+
+
+def hello_payload() -> Dict[str, Any]:
+    return {"protocol_version": list(PROTOCOL_VERSION),
+            "schema_hash": schema_hash()}
+
+
+def check_hello(peer: Dict[str, Any]) -> Optional[str]:
+    """None if compatible, else a reason string. Major must match;
+    minor skew and schema-hash skew are compatible (unknown fields are
+    ignored) but the hash is surfaced for diagnostics."""
+    ver = peer.get("protocol_version")
+    if not isinstance(ver, (list, tuple)) or len(ver) != 2:
+        return f"malformed protocol_version: {ver!r}"
+    if int(ver[0]) != PROTOCOL_VERSION[0]:
+        return (f"incompatible protocol major version {ver[0]} "
+                f"(ours: {PROTOCOL_VERSION[0]})")
+    return None
+
+
+def validate(method: str, payload: Any) -> List[str]:
+    """Field errors for ``payload`` against ``method``'s schema;
+    empty list = valid (or method not in the table)."""
+    spec = SCHEMAS.get(method)
+    if spec is None:
+        return []
+    if payload is None:
+        payload = {}
+    if not isinstance(payload, dict):
+        return [f"{method}: payload must be a map, got "
+                f"{type(payload).__name__}"]
+    errors = []
+    for field, (ftype, required) in spec.items():
+        if field not in payload:
+            if required:
+                errors.append(f"{method}.{field}: required field missing")
+            continue
+        value = payload[field]
+        if value is None and not required:
+            continue
+        if ftype is _any:
+            continue
+        if not isinstance(value, ftype):
+            errors.append(
+                f"{method}.{field}: expected "
+                f"{getattr(ftype, '__name__', ftype)}, got "
+                f"{type(value).__name__}")
+    return errors
+
+
+def validation_enabled() -> bool:
+    return os.environ.get("RTPU_VALIDATE_WIRE", "") not in ("", "0")

@@ -1278,7 +1278,7 @@ class Worker:
             if payload.get("app_error") and state.retries_left != 0 and \
                     state.spec.get("retry_exceptions"):
                 state.retries_left -= 1
-                asyncio.get_running_loop().create_task(
+                protocol.spawn(
                     self._retry(state))
                 return {}
             state.done = True

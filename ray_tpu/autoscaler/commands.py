@@ -86,9 +86,13 @@ def _start_detached_head(config: Dict[str, Any]) -> Dict[str, Any]:
 
 def create_or_update_cluster(
         config: Union[str, Dict[str, Any]], *,
-        api_client=None) -> Dict[str, Any]:
+        api_client=None, ec2_client=None,
+        compute_client=None) -> Dict[str, Any]:
     """Bring the cluster to its configured min size. Returns the state
     dict (also persisted for `ray-tpu down`)."""
+    provider_runtime = {"api_client": api_client,
+                        "ec2_client": ec2_client,
+                        "compute_client": compute_client}
     cfg = _resolve(config)
     name = cfg["cluster_name"]
     ptype = cfg["provider"]["type"]
@@ -167,12 +171,71 @@ def create_or_update_cluster(
             _save_state(name, state)
         return state
 
+    if ptype in ("aws", "azure"):
+        provider = make_provider(cfg, **provider_runtime)
+        try:
+            live = set(provider.non_terminated_nodes())
+            state["nodes"] = {nid: info for nid, info
+                              in state["nodes"].items() if nid in live}
+            created: list = []
+            # phase 1: create every missing node (fast API calls)
+            for tname, nt in cfg["available_node_types"].items():
+                target = nt.get("min_workers", 0)
+                if tname == cfg.get("head_node_type"):
+                    target = max(target, 1)
+                have = sum(1 for s in state["nodes"].values()
+                           if s["type"] == tname)
+                for _ in range(max(0, target - have)):
+                    (nid,) = provider.create_node(
+                        nt.get("node_config") or {}, 1)
+                    state["nodes"][nid] = {"type": tname}
+                    created.append((nid, tname))
+                    _save_state(name, state)
+            # phase 2: bootstrap CONCURRENTLY (reference: one
+            # NodeUpdaterThread per node — a single unreachable node
+            # must not serialize the whole cluster behind its
+            # ready_timeout)
+            if created and (cfg.get("auth") or cfg.get(
+                    "setup_commands") or cfg.get("file_mounts")):
+                from concurrent.futures import ThreadPoolExecutor
+                from ray_tpu.autoscaler.updater import (
+                    NodeUpdateError, update_node_from_config)
+
+                def _bootstrap(item):
+                    nid, tname = item
+                    ip = provider.external_ip(nid)
+                    if not ip:
+                        return nid, None, "no reachable ip"
+                    try:
+                        upd = update_node_from_config(
+                            ip, cfg, is_head=(
+                                tname == cfg.get("head_node_type")))
+                        return nid, upd.phases_done, None
+                    except NodeUpdateError as e:
+                        return nid, None, str(e)[:500]
+
+                with ThreadPoolExecutor(max_workers=8) as pool:
+                    for nid, phases, err in pool.map(_bootstrap,
+                                                     created):
+                        if phases is not None:
+                            state["nodes"][nid]["bootstrap"] = phases
+                        if err is not None:
+                            state["nodes"][nid]["bootstrap_error"] = err
+                        _save_state(name, state)
+        finally:
+            _save_state(name, state)
+        return state
+
     raise ConfigError(f"ray-tpu up does not support provider {ptype!r}")
 
 
 def teardown_cluster(config: Union[str, Dict[str, Any]], *,
-                     api_client=None) -> int:
+                     api_client=None, ec2_client=None,
+                     compute_client=None) -> int:
     """Terminate every node `up` created. Returns nodes torn down."""
+    provider_runtime = {"api_client": api_client,
+                        "ec2_client": ec2_client,
+                        "compute_client": compute_client}
     cfg = _resolve(config)
     name = cfg["cluster_name"]
     state = _load_state(name)
@@ -204,14 +267,22 @@ def teardown_cluster(config: Union[str, Dict[str, Any]], *,
                 except Exception:
                     pass
             n += 1
-    elif ptype == "gcp_tpu":
-        provider = make_provider(cfg, api_client=api_client)
-        for nid in state.get("nodes", {}):
+    elif ptype in ("gcp_tpu", "aws", "azure"):
+        provider = make_provider(cfg, **provider_runtime)
+        for nid in list(state.get("nodes", {})):
             try:
                 provider.terminate_node(nid)
                 n += 1
+                # prune per node: a failed later termination must not
+                # lose the record of the ones still running (billable!)
+                state["nodes"].pop(nid, None)
+                _save_state(name, state)
             except Exception:
                 pass
+        if state.get("nodes"):
+            # terminations failed: keep the state file so a retried
+            # `down` still knows which nodes exist
+            return n
     try:
         os.remove(_state_path(name))
     except OSError:

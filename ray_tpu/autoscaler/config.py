@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-PROVIDER_TYPES = ("fake_multinode", "gcp_tpu", "external")
+PROVIDER_TYPES = ("fake_multinode", "gcp_tpu", "aws", "azure",
+                  "kubernetes", "external")
 
 _DEFAULTS: Dict[str, Any] = {
     "max_workers": 8,
@@ -58,6 +59,12 @@ def validate_config(config: Dict[str, Any]):
             if not provider.get(req):
                 raise ConfigError(f"provider.{req} is required for "
                                   "gcp_tpu")
+    if ptype == "aws" and not provider.get("region"):
+        raise ConfigError("provider.region is required for aws")
+    if ptype == "azure":
+        for req in ("subscription_id", "resource_group"):
+            if not provider.get(req):
+                raise ConfigError(f"provider.{req} is required for azure")
     node_types = config.get("available_node_types")
     if not isinstance(node_types, dict) or not node_types:
         raise ConfigError("available_node_types must be a non-empty dict")
@@ -89,6 +96,18 @@ def make_provider(config: Dict[str, Any], **runtime):
         from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
         return GCPTPUNodeProvider(provider,
                                   api_client=runtime.get("api_client"))
+    if ptype == "aws":
+        from ray_tpu.autoscaler.aws import AWSNodeProvider
+        return AWSNodeProvider(provider,
+                               ec2_client=runtime.get("ec2_client"))
+    if ptype == "azure":
+        from ray_tpu.autoscaler.azure import AzureNodeProvider
+        return AzureNodeProvider(
+            provider, compute_client=runtime.get("compute_client"))
+    if ptype == "kubernetes":
+        from ray_tpu.autoscaler.kubernetes import KubernetesNodeProvider
+        return KubernetesNodeProvider(
+            provider, k8s_client=runtime.get("k8s_client"))
     if ptype == "external":
         # provider.module = "pkg.mod:ClassName"
         mod_path = provider.get("module")

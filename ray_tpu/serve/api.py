@@ -126,6 +126,8 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
     """Start (or connect to) the Serve controller; http_port=None means
     no HTTP ingress. An explicit port starts the proxy even when the
     controller already exists."""
+    from ray_tpu._private import usage as _usage
+    _usage.record_library_usage("serve")
     controller = None
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)

@@ -479,6 +479,8 @@ def run(trainable: Union[Callable, Type[Trainable]],
         resume: bool = False,
         verbose: int = 0) -> "ExperimentAnalysis":
     """The reference's tune.run (tune/tune.py:131)."""
+    from ray_tpu._private import usage as _usage
+    _usage.record_library_usage("tune")
     config = config or {}
     if isinstance(trainable, type) and issubclass(trainable, Trainable):
         trainable_cls = trainable

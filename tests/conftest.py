@@ -14,6 +14,10 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RTPU_PRESTART_WORKERS", "0")
+# Every inbound RPC in every test process is validated against the
+# declared wire schema (_private/schema.py) — handler/schema drift
+# fails loudly here instead of silently skewing the protocol.
+os.environ.setdefault("RTPU_VALIDATE_WIRE", "1")
 
 # Tune writes experiment dirs (loggers + resumable state) to this root by
 # default; keep test runs out of $HOME.

@@ -1,0 +1,273 @@
+"""AWS/Azure providers + SSH/docker updater, driven with injected fakes.
+
+Reference analogues: autoscaler/_private/aws/node_provider.py,
+_azure/node_provider.py, command_runner.py, updater.py — tested the
+way the GCE TPU provider is: a fake transport/binary stands in for the
+cloud, so the provider/updater LOGIC runs for real.
+"""
+
+import os
+import stat
+
+import pytest
+
+from ray_tpu.autoscaler.aws import AWSNodeProvider
+from ray_tpu.autoscaler.azure import AzureNodeProvider
+from ray_tpu.autoscaler.command_runner import (DockerCommandRunner,
+                                               SSHCommandRunner)
+from ray_tpu.autoscaler.config import (ConfigError, make_provider,
+                                        prepare_config, validate_config)
+from ray_tpu.autoscaler.updater import NodeUpdateError, NodeUpdater
+
+
+# ----------------------------------------------------------------- fakes
+
+class FakeEC2:
+    """Duck-typed boto3 ec2 client over an in-memory instance table."""
+
+    def __init__(self):
+        self.instances = {}
+        self._n = 0
+
+    def run_instances(self, **params):
+        out = []
+        for _ in range(params["MinCount"]):
+            self._n += 1
+            iid = f"i-{self._n:08x}"
+            tags = params["TagSpecifications"][0]["Tags"]
+            self.instances[iid] = {
+                "InstanceId": iid, "State": "running",
+                "InstanceType": params["InstanceType"],
+                "Tags": tags, "PublicIpAddress": f"10.0.0.{self._n}"}
+            out.append(self.instances[iid])
+        return {"Instances": out}
+
+    def describe_instances(self, Filters=None, InstanceIds=None):
+        insts = list(self.instances.values())
+        if InstanceIds:
+            insts = [i for i in insts if i["InstanceId"] in InstanceIds]
+        if Filters:
+            for f in Filters:
+                if f["Name"].startswith("tag:"):
+                    key = f["Name"][4:]
+                    insts = [i for i in insts
+                             if any(t["Key"] == key
+                                    and t["Value"] in f["Values"]
+                                    for t in i["Tags"])]
+                elif f["Name"] == "instance-state-name":
+                    insts = [i for i in insts
+                             if i["State"] in f["Values"]]
+        return {"Reservations": [{"Instances": insts}]}
+
+    def terminate_instances(self, InstanceIds):
+        for iid in InstanceIds:
+            self.instances.pop(iid, None)
+
+
+class FakeAzureCompute:
+    def __init__(self):
+        self.vms = {}
+
+    def list_vms(self, resource_group):
+        return list(self.vms.values())
+
+    def create_vm(self, resource_group, spec):
+        self.vms[spec["name"]] = {**spec, "provisioning_state":
+                                  "Succeeded",
+                                  "public_ip":
+                                      f"10.1.0.{len(self.vms) + 1}"}
+
+    def delete_vm(self, resource_group, name):
+        self.vms.pop(name, None)
+
+
+# -------------------------------------------------------------- providers
+
+def test_aws_provider_lifecycle():
+    ec2 = FakeEC2()
+    p = AWSNodeProvider({"region": "us-west-2",
+                         "cluster_name": "c1"}, ec2_client=ec2)
+    ids = p.create_node({"InstanceType": "m5.4xlarge",
+                         "node_kind": "worker"}, 2)
+    assert len(ids) == 2
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    assert p.node_resources(ids[0]) == {"CPU": 16.0}
+    assert p.external_ip(ids[0]).startswith("10.0.0.")
+    # other clusters' instances are invisible
+    other = AWSNodeProvider({"region": "us-west-2",
+                             "cluster_name": "c2"}, ec2_client=ec2)
+    assert other.non_terminated_nodes() == []
+    p.terminate_node(ids[0])
+    assert p.non_terminated_nodes() == [ids[1]]
+
+
+def test_azure_provider_lifecycle():
+    az = FakeAzureCompute()
+    p = AzureNodeProvider({"subscription_id": "s", "resource_group": "g",
+                           "cluster_name": "c1"}, compute_client=az)
+    ids = p.create_node({"vm_size": "Standard_D8s_v3"}, 2)
+    assert len(ids) == 2 and all(i.startswith("c1-") for i in ids)
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    assert p.node_resources(ids[0]) == {"CPU": 8.0}
+    assert p.external_ip(ids[0]).startswith("10.1.0.")
+    p.terminate_node(ids[0])
+    assert p.non_terminated_nodes() == [ids[1]]
+
+
+def test_provider_registry_and_validation():
+    base = {"cluster_name": "c", "max_workers": 4,
+            "available_node_types": {"t": {"min_workers": 0}}}
+    validate_config(prepare_config(
+        {**base, "provider": {"type": "aws", "region": "us-east-1"}}))
+    with pytest.raises(ConfigError, match="region"):
+        validate_config(prepare_config(
+            {**base, "provider": {"type": "aws"}}))
+    with pytest.raises(ConfigError, match="subscription_id"):
+        validate_config(prepare_config(
+            {**base, "provider": {"type": "azure"}}))
+    p = make_provider(
+        {**base, "provider": {"type": "aws", "region": "r"}},
+        ec2_client=FakeEC2())
+    assert isinstance(p, AWSNodeProvider)
+    p = make_provider(
+        {**base, "provider": {"type": "azure", "subscription_id": "s",
+                              "resource_group": "g"}},
+        compute_client=FakeAzureCompute())
+    assert isinstance(p, AzureNodeProvider)
+
+
+def test_up_and_down_with_aws_fake(tmp_path, monkeypatch):
+    from ray_tpu.autoscaler import commands
+    monkeypatch.setattr(commands, "STATE_DIR", str(tmp_path))
+    ec2 = FakeEC2()
+    cfg = {"cluster_name": "awsup",
+           "provider": {"type": "aws", "region": "r"},
+           "head_node_type": "head",
+           "available_node_types": {
+               "head": {"min_workers": 0,
+                        "node_config": {"InstanceType": "m5.xlarge"}},
+               "cpu": {"min_workers": 2,
+                       "node_config": {"InstanceType": "m5.large"}}}}
+    state = commands.create_or_update_cluster(cfg, ec2_client=ec2)
+    assert len(state["nodes"]) == 3  # 1 head + 2 workers
+    # idempotent: a second up creates nothing new
+    state = commands.create_or_update_cluster(cfg, ec2_client=ec2)
+    assert len(state["nodes"]) == 3
+    assert len(ec2.instances) == 3
+    n = commands.teardown_cluster(cfg, ec2_client=ec2)
+    assert n == 3 and not ec2.instances
+
+
+# ------------------------------------------------------- runner + updater
+
+@pytest.fixture
+def fake_ssh(tmp_path):
+    """An "ssh" that drops connection args and runs the command
+    locally — the command after `--` is `bash -lc <cmd>`."""
+    fake = tmp_path / "ssh"
+    # mimics REAL ssh: the remote args are space-joined into one string
+    # handed to the login shell (so quoting bugs surface here too)
+    fake.write_text("""#!/bin/sh
+while [ "$1" != "--" ]; do shift; done
+shift
+exec sh -c "$*"
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    return str(fake)
+
+
+def test_ssh_runner_and_updater_phases(tmp_path, fake_ssh):
+    marker = tmp_path / "order.txt"
+    runner = SSHCommandRunner("1.2.3.4", user="u", ssh_binary=fake_ssh)
+    rc, out = runner.run("echo hello")
+    assert rc == 0 and "hello" in out
+
+    upd = NodeUpdater(
+        runner,
+        initialization_commands=[f"echo init >> {marker}"],
+        setup_commands=[f"echo setup >> {marker}"],
+        start_commands=[f"echo start >> {marker}"])
+    upd.update()
+    assert marker.read_text().split() == ["init", "setup", "start"]
+    assert upd.phases_done == ["wait_ready", "file_mounts",
+                               "initialization_commands",
+                               "setup_commands", "start_commands"]
+
+
+def test_updater_failure_names_phase(fake_ssh):
+    runner = SSHCommandRunner("1.2.3.4", ssh_binary=fake_ssh)
+    upd = NodeUpdater(runner, setup_commands=["false"],
+                      start_commands=["echo never"])
+    with pytest.raises(NodeUpdateError) as ei:
+        upd.update()
+    assert ei.value.phase == "setup_commands"
+    assert "start_commands" not in upd.phases_done
+
+
+def test_docker_runner_wraps_commands(fake_ssh, tmp_path):
+    log = tmp_path / "docker.log"
+    fake_docker = tmp_path / "docker"
+    fake_docker.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+case "$1" in inspect) exit 1;; esac
+exit 0
+""")
+    fake_docker.chmod(fake_docker.stat().st_mode | stat.S_IEXEC)
+    base = SSHCommandRunner("1.2.3.4", ssh_binary=fake_ssh)
+    d = DockerCommandRunner(base, image="img:1",
+                            docker_binary=str(fake_docker))
+    assert d.ensure_container()[0] == 0
+    assert d.run("echo inside")[0] == 0
+    text = log.read_text()
+    assert "run -d --name ray_tpu_container" in text
+    assert "exec ray_tpu_container" in text
+
+
+# ----------------------------------------------------- kubernetes/kuberay
+
+class FakeK8s:
+    def __init__(self):
+        self.pods = {}
+
+    def list_pods(self, namespace):
+        return list(self.pods.values())
+
+    def create_pod(self, namespace, pod):
+        self.pods[pod["name"]] = {**pod, "phase": "Running"}
+
+    def delete_pod(self, namespace, name):
+        self.pods.pop(name, None)
+
+
+def test_kubernetes_provider_and_operator_reconcile():
+    from ray_tpu.autoscaler.kubernetes import (KubernetesNodeProvider,
+                                               RayClusterOperator)
+    k8s = FakeK8s()
+    p = KubernetesNodeProvider({"namespace": "ns", "cluster_name": "c1"},
+                               k8s_client=k8s)
+    op = RayClusterOperator(p)
+    spec = {"head": {"image": "img", "resources": {"CPU": 4}},
+            "worker_groups": [
+                {"name": "cpu", "replicas": 2, "resources": {"CPU": 2}},
+                {"name": "tpu", "replicas": 1,
+                 "resources": {"CPU": 8, "TPU": 4}}]}
+    a = op.reconcile(spec)
+    assert len(a["created"]) == 4 and not a["deleted"]  # 1 head + 3
+    assert len(p.non_terminated_nodes()) == 4
+    # idempotent second pass
+    a = op.reconcile(spec)
+    assert not a["created"] and not a["deleted"]
+    # a dead worker pod is replaced
+    cpu_pod = next(n for n in k8s.pods if "-cpu-" in n)
+    k8s.pods[cpu_pod]["phase"] = "Failed"
+    a = op.reconcile(spec)
+    assert len(a["created"]) == 1
+    # scale down + group removal
+    spec["worker_groups"][0]["replicas"] = 1
+    spec["worker_groups"].pop(1)  # drop the tpu group
+    a = op.reconcile(spec)
+    assert len(a["deleted"]) == 2  # one cpu scale-down + one tpu stray
+    groups = {(pod["labels"]["ray-tpu.io/group"])
+              for pod in k8s.pods.values() if pod["phase"] == "Running"}
+    assert groups == {"head", "cpu"}
+    assert p.node_resources(p.non_terminated_nodes()[0])

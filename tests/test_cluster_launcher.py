@@ -37,7 +37,7 @@ def test_config_validation_errors():
         prepare_config({"provider": {"type": "fake_multinode"},
                         "available_node_types": {"a": {}}})
     with pytest.raises(ConfigError, match="provider.type"):
-        prepare_config(_base_cfg(provider={"type": "aws"}))
+        prepare_config(_base_cfg(provider={"type": "nonexistent_cloud"}))
     with pytest.raises(ConfigError, match="project_id"):
         prepare_config(_base_cfg(provider={"type": "gcp_tpu"}))
     with pytest.raises(ConfigError, match="min_workers"):

@@ -150,5 +150,7 @@ def test_trials_exceed_cluster_cpus(cluster):
                         config={"x": tune.grid_search(list(range(1, 11)))},
                         metric="score", mode="max", verbose=0)
     assert len(analysis.trials) == 10
-    assert all(t.status == "TERMINATED" for t in analysis.trials)
+    bad = [(t.trial_id, t.status, (t.error or "")[:500])
+           for t in analysis.trials if t.status != "TERMINATED"]
+    assert not bad, f"non-terminated trials: {bad}"
     assert analysis.get_best_trial().last_result["score"] == 30
