@@ -14,13 +14,15 @@ from ray_tpu.data.grouped_data import GroupedData
 from ray_tpu.data.read_api import (Datasource, from_arrow, from_items,
                                    from_numpy, from_pandas, range,
                                    range_tensor, read_binary_files, read_csv,
-                                   read_datasource, read_json, read_numpy,
-                                   read_parquet, read_text)
+                                   read_datasource, read_images, read_json,
+                                   read_mongo, read_numpy, read_parquet,
+                                   read_text)
 
 __all__ = [
     "Dataset", "DatasetPipeline", "GroupedData", "Block", "BlockAccessor",
     "BlockMetadata", "Datasource", "range", "range_tensor", "from_items",
     "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
     "read_json", "read_numpy", "read_text", "read_binary_files",
+    "read_images", "read_mongo",
     "read_datasource", "ActorPoolStrategy", "TaskPoolStrategy",
 ]

@@ -461,6 +461,76 @@ class Dataset:
         if prev is not None:
             yield prev
 
+    def iter_tf_batches(self, *, batch_size: int = 256,
+                        dtypes=None, drop_last: bool = False,
+                        prefetch_blocks: int = 1, **kw) -> Iterator[Any]:
+        """iter_batches with columns converted to tf tensors
+        (reference: Dataset.iter_tf_batches)."""
+        import tensorflow as tf
+
+        def _to_tf(col, name):
+            want = (dtypes.get(name) if isinstance(dtypes, dict)
+                    else dtypes)
+            return tf.convert_to_tensor(np.ascontiguousarray(col),
+                                        dtype=want)
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last, prefetch_blocks=prefetch_blocks,
+                **kw):
+            if isinstance(batch, dict):
+                yield {k: _to_tf(v, k) for k, v in batch.items()}
+            else:
+                yield _to_tf(batch, VALUE_COL)
+
+    def to_tf(self, *, feature_columns, label_columns=None,
+              batch_size: int = 256,
+              drop_last: bool = False) -> Any:
+        """A ``tf.data.Dataset`` over this dataset's batches
+        (reference: Dataset.to_tf — feature/label column split, batched).
+        ``feature_columns``/``label_columns`` may be one name or a list;
+        a list yields a dict of tensors per element."""
+        import tensorflow as tf
+
+        first = self.take(1)
+        if not first:
+            raise ValueError("to_tf on an empty dataset: the element "
+                             "spec cannot be inferred from zero rows")
+        one = first[0]
+
+        def _spec(cols):
+            if cols is None:
+                return None
+
+            def spec_for(name):
+                v = np.asarray(one[name])
+                return tf.TensorSpec(shape=(None,) + v.shape,
+                                     dtype=tf.as_dtype(v.dtype))
+            if isinstance(cols, str):
+                return spec_for(cols)
+            return {c: spec_for(c) for c in cols}
+
+        feat_spec = _spec(feature_columns)
+        label_spec = _spec(label_columns)
+
+        def _select(batch, cols):
+            if isinstance(cols, str):
+                return tf.convert_to_tensor(batch[cols])
+            return {c: tf.convert_to_tensor(batch[c]) for c in cols}
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                feats = _select(batch, feature_columns)
+                if label_columns is None:
+                    yield feats
+                else:
+                    yield feats, _select(batch, label_columns)
+
+        sig = feat_spec if label_spec is None else (feat_spec, label_spec)
+        return tf.data.Dataset.from_generator(gen, output_signature=sig)
+
     def to_pandas(self):
         import pandas as pd
         import ray_tpu

@@ -162,6 +162,67 @@ def read_numpy(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     return _read_files(files, _r, parallelism)
 
 
+def read_images(paths, *, parallelism: int = DEFAULT_PARALLELISM,
+                size: Optional[tuple] = None,
+                mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    """Decode image files into an ``image`` column of HxWxC uint8
+    arrays (reference: data/read_api.py read_images over
+    ImageDatasource). ``size=(h, w)`` resizes on read — decode-time
+    resize keeps the block memory bounded and the downstream arrays
+    static-shaped (what a TPU input pipeline wants)."""
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith(exts)]
+    if not files:
+        raise ValueError(
+            f"read_images: no files with extensions {exts} under "
+            f"{paths!r}")
+
+    def _r(path):
+        from PIL import Image
+        with Image.open(path) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize((size[1], size[0]))
+            arr = np.asarray(im, dtype=np.uint8)
+        if arr.ndim == 2:  # grayscale modes keep the HxWxC contract
+            arr = arr[:, :, None]
+        row = {"image": arr}
+        if include_paths:
+            row["path"] = path
+        return [row]
+    return _read_files(files, _r, parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               parallelism: int = DEFAULT_PARALLELISM,
+               pipeline: Optional[List[dict]] = None) -> Dataset:
+    """Read a MongoDB collection (reference: read_api.py read_mongo over
+    MongoDatasource). Gated on pymongo, which does not ship in this
+    image — exactly like the reference gates on its connectors.
+
+    The documents are materialized on the driver before blocking (the
+    reference's MongoDatasource partitions by _id range into remote
+    read tasks); acceptable for the modest collections this connector
+    targets — use the file readers for bulk data."""
+    try:
+        import pymongo
+    except ImportError as e:
+        raise RuntimeError(
+            "read_mongo requires pymongo (not installed)") from e
+    client = pymongo.MongoClient(uri)
+    try:
+        coll = client[database][collection]
+        docs = list(coll.aggregate(pipeline) if pipeline
+                    else coll.find())
+    finally:
+        client.close()
+    for d in docs:
+        d.pop("_id", None)
+    return from_items(docs, parallelism=parallelism)
+
+
 def read_text(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     files = _expand_paths(paths)
 

@@ -24,6 +24,8 @@ from ray_tpu.rllib.algorithms.bandit import (BanditLinTS,
                                              BanditLinTSConfig,
                                              BanditLinUCB,
                                              BanditLinUCBConfig)
+from ray_tpu.rllib.algorithms.alpha_star import (AlphaStar,
+                                                 AlphaStarConfig)
 from ray_tpu.rllib.algorithms.alpha_zero import (AlphaZero,
                                                  AlphaZeroConfig)
 from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
@@ -44,5 +46,6 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "BanditLinTS", "BanditLinTSConfig",
            "QMix", "QMixConfig", "R2D2", "R2D2Config", "DT", "DTConfig",
            "MADDPG", "MADDPGConfig",
+           "AlphaStar", "AlphaStarConfig",
            "AlphaZero", "AlphaZeroConfig", "Dreamer", "DreamerConfig",
            "MAML", "MAMLConfig", "SlateQ", "SlateQConfig"]

@@ -1,0 +1,388 @@
+"""AlphaStar-style league self-play training.
+
+Reference analogue: rllib/algorithms/alpha_star/ (league-based
+training: a LEAGUE of policies — main agents, main exploiters, league
+exploiters, frozen historical snapshots — matched by prioritized
+fictitious self-play over a payoff matrix; distributed_learners.py +
+league_builder.py). The full game there is StarCraft; the
+architecturally distinct machinery is the LEAGUE: PFSP matchmaking,
+exploiter roles, periodic snapshotting, and a win-rate payoff table —
+reproduced here on the in-repo two-player board games (alpha_zero.py's
+TicTacToe/Connect4) with jitted REINFORCE-with-baseline updates per
+learnable player. One process, jax-first: every learner shares one
+network ARCHITECTURE (a pytree of params per player), so a single
+jitted update function serves the whole league.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, LocalAlgorithm
+from ray_tpu.rllib.algorithms.alpha_zero import GAMES
+
+MAIN = "main"
+MAIN_EXPLOITER = "main_exploiter"
+LEAGUE_EXPLOITER = "league_exploiter"
+HISTORICAL = "historical"
+
+
+class _PolicyNet(nn.Module):
+    num_actions: int
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Dense(self.hidden)(x))
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        logits = nn.Dense(self.num_actions)(h)
+        value = nn.Dense(1)(h)
+        return logits, value[..., 0]
+
+
+class LeaguePlayer:
+    """One league member (reference: league_builder.py Player*)."""
+
+    def __init__(self, pid: str, ptype: str, params):
+        self.pid = pid
+        self.ptype = ptype
+        self.params = params
+        self.games = 0
+
+    @property
+    def learnable(self) -> bool:
+        return self.ptype != HISTORICAL
+
+
+def pfsp_weights(win_rates: np.ndarray, mode: str = "squared"
+                 ) -> np.ndarray:
+    """Prioritized fictitious self-play opponent weighting (reference:
+    alpha_star/league_builder.py pfsp): weight opponents the learner
+    does NOT reliably beat. ``win_rates`` are the LEARNER's win rates
+    vs each candidate."""
+    p = np.clip(win_rates, 0.0, 1.0)
+    if mode == "squared":
+        w = (1.0 - p) ** 2
+    elif mode == "variance":
+        w = p * (1.0 - p)
+    else:
+        w = 1.0 - p
+    w = w + 1e-3  # never fully starve an opponent
+    return w / w.sum()
+
+
+class AlphaStarConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or AlphaStar)
+        self._config.update({
+            "env": "tictactoe",
+            "hidden": 64,
+            "lr": 3e-3,
+            "gamma": 1.0,
+            "matches_per_iter": 64,
+            "entropy_coeff": 0.01,
+            "vf_coeff": 0.5,
+            # league shape (reference defaults scaled to one process)
+            "num_main_exploiters": 1,
+            "num_league_exploiters": 1,
+            "snapshot_interval": 10,   # iterations between main snapshots
+            "max_historical": 8,
+            # matchmaking mix for the main agent (reference: 35% SP /
+            # 50% PFSP / 15% exploiter-targeting)
+            "main_self_play_prob": 0.35,
+            "payoff_ema": 0.05,
+        })
+
+
+class AlphaStar(LocalAlgorithm):
+    """League training loop: sample matches by PFSP, update the
+    learnable participant on each game, snapshot main periodically."""
+
+    _default_config_cls = AlphaStarConfig
+
+    def setup(self, config):
+        base = self.get_default_config().to_dict()
+        base.update(config or {})
+        self.config = cfg = base
+        game_cls = GAMES.get(cfg["env"])
+        if game_cls is None:
+            raise ValueError(
+                f"AlphaStar env must be one of {sorted(GAMES)}")
+        self.game = game_cls()
+        self.net = _PolicyNet(self.game.num_actions, cfg["hidden"])
+        self._rng = jax.random.PRNGKey(cfg.get("seed") or 0)
+        dummy = jnp.zeros((1,) + self.game.obs_shape)
+
+        def fresh_params(key):
+            return self.net.init(key, dummy)["params"]
+
+        self.optimizer = optax.adam(cfg["lr"])
+        # the league (reference: league_builder.py __init__): one main,
+        # N main exploiters, M league exploiters; separate param sets
+        self.league: Dict[str, LeaguePlayer] = {}
+        keys = jax.random.split(self._rng, 2 + cfg["num_main_exploiters"]
+                                + cfg["num_league_exploiters"])
+        self.league[MAIN] = LeaguePlayer(MAIN, MAIN, fresh_params(keys[0]))
+        for i in range(cfg["num_main_exploiters"]):
+            pid = f"{MAIN_EXPLOITER}_{i}"
+            self.league[pid] = LeaguePlayer(pid, MAIN_EXPLOITER,
+                                            fresh_params(keys[1 + i]))
+        for i in range(cfg["num_league_exploiters"]):
+            pid = f"{LEAGUE_EXPLOITER}_{i}"
+            self.league[pid] = LeaguePlayer(
+                pid, LEAGUE_EXPLOITER,
+                fresh_params(keys[1 + cfg["num_main_exploiters"] + i]))
+        # payoff[a][b] = EMA win rate of a against b (reference: the
+        # league's payoff matrix driving PFSP)
+        self.payoff: Dict[str, Dict[str, float]] = {}
+        self._opt_states: Dict[str, Any] = {
+            pid: self.optimizer.init(p.params)
+            for pid, p in self.league.items() if p.learnable}
+        self._jit_logits = jax.jit(
+            lambda p, o: self.net.apply({"params": p}, o))
+        self._jit_update = jax.jit(self._update_impl)
+        self._snapshots = 0
+        # LocalAlgorithm checkpoint surface
+        self.params = self.league[MAIN].params
+        self.target_params = self.params
+        self.opt_state = self._opt_states[MAIN]
+        self._init_local_state()
+
+    # -------------------------------------------------------------- play
+
+    def _act(self, params, state, greedy: bool = False
+             ) -> Tuple[int, np.ndarray]:
+        g = self.game
+        obs = g.observation(state)
+        logits, _ = self._jit_logits(params, jnp.asarray(obs)[None])
+        logits = np.asarray(logits[0], np.float64)
+        legal = g.legal_actions(state)
+        mask = np.full_like(logits, -np.inf)
+        mask[legal] = 0.0
+        logits = logits + mask
+        if greedy:
+            return int(np.argmax(logits)), obs
+        z = logits - logits.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(self._np_rng.choice(len(p), p=p)), obs
+
+    def _play_game(self, pa, pb) -> Tuple[float, List, List]:
+        """One game, player a moves first. Returns (outcome for a in
+        {1, 0.5, 0}, a's trajectory, b's trajectory) where each
+        trajectory is [(obs, action)]."""
+        g = self.game
+        state = g.initial_state()
+        trajs = ([], [])
+        params = (pa, pb)
+        mover = 0
+        while True:
+            tv = g.terminal_value(state)
+            if tv is not None:
+                # tv is from the perspective of the player TO MOVE
+                # (-1 = previous mover won, 0 = draw)
+                if tv == 0.0:
+                    return 0.5, trajs[0], trajs[1]
+                winner = 1 - mover  # previous mover
+                return (1.0 if winner == 0 else 0.0,
+                        trajs[0], trajs[1])
+            a, obs = self._act(params[mover], state)
+            trajs[mover].append((obs, a))
+            state = g.next_state(state, a)
+            mover = 1 - mover
+
+    # ---------------------------------------------------------- learning
+
+    def _update_impl(self, params, opt_state, obs, actions, returns):
+        def loss_fn(p):
+            logits, values = self.net.apply({"params": p}, obs)
+            logp = jax.nn.log_softmax(logits)
+            chosen = jnp.take_along_axis(
+                logp, actions[:, None], axis=1)[:, 0]
+            adv = returns - values
+            pg = -jnp.mean(chosen * jax.lax.stop_gradient(adv))
+            vf = jnp.mean(adv ** 2)
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=1))
+            cfg = self.config
+            return (pg + cfg["vf_coeff"] * vf
+                    - cfg["entropy_coeff"] * ent), (pg, vf, ent)
+
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def _learn_from(self, pid: str, traj: List, outcome: float):
+        if not traj:
+            return
+        player = self.league[pid]
+        ret = 2.0 * outcome - 1.0  # {0, 0.5, 1} -> {-1, 0, +1}
+        obs = jnp.asarray(np.stack([o for o, _ in traj]))
+        actions = jnp.asarray(np.array([a for _, a in traj], np.int32))
+        returns = jnp.full((len(traj),), ret, jnp.float32)
+        player.params, self._opt_states[pid], _ = self._jit_update(
+            player.params, self._opt_states[pid], obs, actions, returns)
+
+    # -------------------------------------------------------- matchmaking
+
+    def _win_rate(self, a: str, b: str) -> float:
+        return self.payoff.get(a, {}).get(b, 0.5)
+
+    def _record(self, a: str, b: str, outcome_a: float):
+        ema = self.config["payoff_ema"]
+        for x, y, o in ((a, b, outcome_a), (b, a, 1.0 - outcome_a)):
+            cur = self.payoff.setdefault(x, {}).get(y, 0.5)
+            self.payoff[x][y] = (1 - ema) * cur + ema * o
+
+    def _choose_opponent(self, pid: str) -> str:
+        """Reference league_builder.get_match: mains mix self-play with
+        PFSP over the whole league; main exploiters target the current
+        main; league exploiters PFSP over everyone."""
+        player = self.league[pid]
+        others = [q for q in self.league if q != pid]
+        if player.ptype == MAIN_EXPLOITER:
+            return MAIN
+        if player.ptype == MAIN and \
+                self._np_rng.random() < self.config["main_self_play_prob"]:
+            return MAIN  # self-play
+        rates = np.array([self._win_rate(pid, q) for q in others])
+        return str(self._np_rng.choice(
+            others, p=pfsp_weights(rates)))
+
+    def _snapshot_main(self):
+        pid = f"{HISTORICAL}_{self._snapshots}"
+        self.league[pid] = LeaguePlayer(pid, HISTORICAL,
+                                        self.league[MAIN].params)
+        self._snapshots += 1
+        hist = [p for p in self.league.values()
+                if p.ptype == HISTORICAL]
+        if len(hist) > self.config["max_historical"]:
+            oldest = min(hist, key=lambda p: int(p.pid.rsplit("_", 1)[-1]))
+            del self.league[oldest.pid]
+            # the payoff table must not accrete dead opponents
+            self.payoff.pop(oldest.pid, None)
+            for row in self.payoff.values():
+                row.pop(oldest.pid, None)
+
+    # ------------------------------------------------------------- driver
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        learners = [pid for pid, p in self.league.items() if p.learnable]
+        games = 0
+        for i in range(cfg["matches_per_iter"]):
+            pid = learners[i % len(learners)]
+            opp = self._choose_opponent(pid)
+            first = bool(self._np_rng.integers(2))
+            pa, pb = (pid, opp) if first else (opp, pid)
+            out_a, ta, tb = self._play_game(self.league[pa].params,
+                                            self.league[pb].params)
+            out_for_pid = out_a if first else 1.0 - out_a
+            traj = ta if first else tb
+            self._learn_from(pid, traj, out_for_pid)
+            if opp != pid:
+                self._record(pid, opp, out_for_pid)
+            self.league[pid].games += 1
+            games += 1
+        self._iteration += 1
+        self._timesteps_total += games
+        if self._iteration % cfg["snapshot_interval"] == 0:
+            self._snapshot_main()
+        self.params = self.league[MAIN].params  # checkpoint surface
+        self.opt_state = self._opt_states[MAIN]
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+            "num_env_steps_sampled": self._timesteps_total,
+            "episodes_this_iter": games,
+            "episode_reward_mean":
+                2.0 * self.eval_vs_random(MAIN, 4) - 1.0,
+            "league_size": len(self.league),
+            "num_historical": sum(1 for p in self.league.values()
+                                  if p.ptype == HISTORICAL),
+            "main_vs_random_win_rate": self.eval_vs_random(MAIN, 20),
+            "payoff_main": dict(self.payoff.get(MAIN, {})),
+            "time_total_s": time.time() - self._t_start,
+        }
+
+    train = step  # Tune surface
+
+    # ---------------------------------------------------------- checkpoint
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        """The league IS the training state: every player's params,
+        the payoff matrix, and the snapshot counter resume together
+        (reference: the league builder checkpoints its whole roster)."""
+        return {
+            "league": {pid: {"ptype": p.ptype, "games": p.games,
+                             "params": jax.device_get(p.params)}
+                       for pid, p in self.league.items()},
+            "opt_states": {pid: jax.device_get(s)
+                           for pid, s in self._opt_states.items()},
+            "payoff": {a: dict(r) for a, r in self.payoff.items()},
+            "snapshots": self._snapshots,
+            "iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        def as_jnp(t):
+            return jax.tree_util.tree_map(
+                jnp.asarray, t,
+                is_leaf=lambda x: isinstance(x, (np.ndarray,
+                                                 np.generic)))
+
+        self.league = {
+            pid: LeaguePlayer(pid, ent["ptype"], as_jnp(ent["params"]))
+            for pid, ent in state["league"].items()}
+        for pid, ent in state["league"].items():
+            self.league[pid].games = ent.get("games", 0)
+        self._opt_states = {pid: as_jnp(s)
+                            for pid, s in state["opt_states"].items()}
+        self.payoff = {a: dict(r) for a, r in state["payoff"].items()}
+        self._snapshots = state["snapshots"]
+        self._iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self.params = self.league[MAIN].params
+        self.target_params = self.params
+        self.opt_state = self._opt_states[MAIN]
+
+    # ---------------------------------------------------------- evaluation
+
+    def _random_move(self, state) -> int:
+        legal = self.game.legal_actions(state)
+        return int(self._np_rng.choice(legal))
+
+    def eval_vs_random(self, pid: str, n_games: int = 20) -> float:
+        """Win rate (draws = 0.5) of ``pid`` against a uniform-random
+        player, alternating first move."""
+        g = self.game
+        total = 0.0
+        params = self.league[pid].params
+        for i in range(n_games):
+            state = g.initial_state()
+            me_first = i % 2 == 0
+            mover_is_me = me_first
+            while True:
+                tv = g.terminal_value(state)
+                if tv is not None:
+                    if tv == 0.0:
+                        total += 0.5
+                    else:
+                        # previous mover won
+                        total += 0.0 if mover_is_me else 1.0
+                    break
+                if mover_is_me:
+                    a, _ = self._act(params, state, greedy=True)
+                else:
+                    a = self._random_move(state)
+                state = g.next_state(state, a)
+                mover_is_me = not mover_is_me
+        return total / n_games
