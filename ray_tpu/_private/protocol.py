@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional
@@ -331,13 +332,69 @@ class EventLoopThread:
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._started = threading.Event()
         self._inflight: set = set()  # strong refs to fire-and-forget tasks
+        # stall detector (reference: the asio event-loop instrumentation
+        # in common/asio/ + the debug loop-lag monitors): a heartbeat
+        # callback stamps the clock; a watchdog thread flags the loop as
+        # stalled — with the loop thread's live stack — when the stamp
+        # goes stale. Enabled via RTPU_LOOP_STALL_S (seconds; 0 = off).
+        self._hb = 0.0
+        self.stalls_detected = 0
+        import os as _os
+        try:
+            self._stall_s = float(
+                _os.environ.get("RTPU_LOOP_STALL_S", "0") or 0)
+        except ValueError:
+            # a typo in an optional debug knob must not kill every
+            # process at startup
+            logging.getLogger(__name__).warning(
+                "ignoring malformed RTPU_LOOP_STALL_S=%r",
+                _os.environ.get("RTPU_LOOP_STALL_S"))
+            self._stall_s = 0.0
         self._thread.start()
         self._started.wait()
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
         self.loop.call_soon(self._started.set)
+        if self._stall_s > 0:
+            self._start_stall_detector()
         self.loop.run_forever()
+
+    def _start_stall_detector(self):
+        import sys
+        import time as _time
+        import traceback as _tb
+        period = self._stall_s / 2
+
+        def beat():
+            self._hb = _time.monotonic()
+            self.loop.call_later(period, beat)
+        self.loop.call_soon(beat)
+        loop_tid = threading.get_ident()
+
+        def watch():
+            warned_hb = -1.0
+            while self.loop.is_running() or self._hb == 0.0:
+                _time.sleep(period)
+                stale = _time.monotonic() - self._hb
+                if self._hb and stale > self._stall_s \
+                        and self._hb != warned_hb:
+                    # one count + one stack per DISTINCT stall: during
+                    # an ongoing stall the heartbeat stamp is frozen,
+                    # so remembering it both dedups the log and keeps
+                    # stalls_detected an event count
+                    warned_hb = self._hb
+                    self.stalls_detected += 1
+                    frame = sys._current_frames().get(loop_tid)
+                    stack = "".join(_tb.format_stack(frame)) \
+                        if frame else "<no frame>"
+                    logging.getLogger(__name__).warning(
+                        "event loop %s stalled %.1fs (a blocking call "
+                        "on the IO loop starves ALL control-plane "
+                        "RPCs):\n%s", self._thread.name, stale, stack)
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"{self._thread.name}-stallwatch").start()
 
     def run(self, coro, timeout: Optional[float] = None):
         """Run coroutine on the IO loop, block until done, return result."""

@@ -1,0 +1,77 @@
+// Concurrency stress harness for the plasmax store (SURVEY §5.2).
+//
+// Built with -fsanitize=thread by tests/test_sanitizers.py (the
+// reference runs its plasma/object_manager tests under TSAN the same
+// way); 8 threads hammer create/seal/get/pin/release/delete on one
+// segment — any data race in the mutex discipline is a TSAN report,
+// which halt_on_error turns into a nonzero exit.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <pthread.h>
+
+extern "C" {
+uint64_t px_segment_size(uint64_t heap_bytes, uint32_t nslots);
+int px_init(void* base, uint64_t seg_size, uint32_t nslots);
+int px_create(void* base, const uint8_t* id, uint64_t size,
+              uint64_t* offset);
+int px_get(void* base, const uint8_t* id, uint64_t* offset,
+           uint64_t* size);
+int px_seal(void* base, const uint8_t* id);
+int px_release(void* base, const uint8_t* id);
+int px_delete(void* base, const uint8_t* id);
+int px_pin(void* base, const uint8_t* id);
+}
+
+static void* g_base;
+
+static void make_id(uint8_t* out, int tid, int i) {
+  // 24-byte object ids, unique per (thread, iteration)
+  std::memset(out, 0, 24);
+  std::snprintf(reinterpret_cast<char*>(out), 24, "%011d-%011d", tid, i);
+}
+
+static void* worker(void* arg) {
+  const int tid = static_cast<int>(reinterpret_cast<intptr_t>(arg));
+  uint64_t off, size;
+  uint8_t oid[24], other[24];
+  for (int i = 0; i < 500; i++) {
+    make_id(oid, tid, i);
+    if (px_create(g_base, oid, 4096, &off) == 0) {
+      std::memset(static_cast<char*>(g_base) + off, tid, 4096);
+      px_seal(g_base, oid);
+      // drop the creator ref (the python client does this inside
+      // seal()) — otherwise refcnt stays 1 forever, px_delete always
+      // refuses, and the delete/eviction/coalesce paths under test
+      // never actually run
+      px_release(g_base, oid);
+    }
+    make_id(other, (tid + 1) % 8, i > 0 ? i - 1 : 0);
+    if (px_get(g_base, other, &off, &size) == 0) {
+      volatile char sink = static_cast<char*>(g_base)[off];  // read it
+      (void)sink;
+      px_release(g_base, other);
+    }
+    if (px_pin(g_base, oid) == 0) px_release(g_base, oid);
+    if (i % 7 == 0) px_delete(g_base, oid);
+  }
+  return nullptr;
+}
+
+int main() {
+  const uint32_t nslots = 8192;
+  const uint64_t seg = px_segment_size(16ull * 1024 * 1024, nslots);
+  static char* mem = new char[seg];
+  g_base = mem;
+  if (px_init(g_base, seg, nslots) != 0) {
+    std::fprintf(stderr, "px_init failed\n");
+    return 2;
+  }
+  pthread_t ts[8];
+  for (intptr_t t = 0; t < 8; t++)
+    pthread_create(&ts[t], nullptr, worker, reinterpret_cast<void*>(t));
+  for (auto& t : ts) pthread_join(t, nullptr);
+  std::printf("STRESS-OK\n");
+  return 0;
+}
