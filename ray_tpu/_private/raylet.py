@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol
 from ray_tpu._private.object_store import PlasmaxStore
+from ray_tpu._private.sched import PendingTask, bundle_key_of, make_ledger
 from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu.common.config import SystemConfig
 from ray_tpu.common.ids import ObjectID
@@ -191,91 +192,6 @@ class WorkerHandle:
         self.log_paths: Tuple[str, str] = ("", "")  # (stdout, stderr)
 
 
-class PendingTask:
-    __slots__ = ("spec", "reply_fut", "demand", "tpu_demand", "submitted_at",
-                 "sched_class")
-
-    def __init__(self, spec, reply_fut):
-        self.spec = spec
-        self.reply_fut = reply_fut
-        self.demand: Dict[str, float] = dict(spec.get("resources", {}))
-        self.tpu_demand = int(self.demand.get("TPU", 0))
-        self.submitted_at = time.monotonic()
-        # scheduling class: tasks in one class are interchangeable for
-        # feasibility (same demand, same PG bundle), so the dispatch loop
-        # can skip a whole class once its head is blocked (reference:
-        # cluster_task_manager's per-SchedulingClass queues)
-        pg = spec.get("placement_group") or None
-        bundle = (pg["pg_id"], pg.get("bundle_index", 0)) if pg else None
-        # spilled-in tasks get their own class: they are feasibility-
-        # equivalent but must not block the spillback drain of plain
-        # tasks queued behind them (spilled tasks don't re-spill)
-        self.sched_class = (tuple(sorted(self.demand.items())), bundle,
-                            bool(spec.get("spilled_from")))
-
-
-class PendingQueue:
-    """Per-scheduling-class FIFO queues of PendingTasks.
-
-    The dispatch loop visits class heads instead of every queued task, so
-    draining N homogeneous tasks costs O(N * classes) feasibility checks
-    rather than O(N^2) — the difference between seconds and hours at the
-    10k-queued-task scale envelope (reference:
-    release/benchmarks/README.md:11, local_task_manager.cc per-class
-    dispatch)."""
-
-    def __init__(self):
-        from collections import deque
-        self._deque = deque  # class attr-free local alias
-        self._classes: "Dict[tuple, Any]" = {}
-        self._count = 0
-
-    def append(self, ptask: PendingTask):
-        q = self._classes.get(ptask.sched_class)
-        if q is None:
-            q = self._classes[ptask.sched_class] = self._deque()
-        q.append(ptask)
-        self._count += 1
-
-    def class_queues(self):
-        """Live (class, deque) pairs; empty classes are pruned."""
-        dead = [c for c, q in self._classes.items() if not q]
-        for c in dead:
-            del self._classes[c]
-        return list(self._classes.items())
-
-    def popleft_from(self, q) -> PendingTask:
-        ptask = q.popleft()
-        self._count -= 1
-        return ptask
-
-    def requeue_front(self, ptask: PendingTask):
-        q = self._classes.get(ptask.sched_class)
-        if q is None:
-            self.append(ptask)
-            return
-        q.appendleft(ptask)
-        self._count += 1
-
-    def remove(self, ptask: PendingTask) -> bool:
-        q = self._classes.get(ptask.sched_class)
-        if q is None:
-            return False
-        try:
-            q.remove(ptask)
-        except ValueError:
-            return False
-        self._count -= 1
-        return True
-
-    def __iter__(self):
-        for q in self._classes.values():
-            yield from q
-
-    def __len__(self):
-        return self._count
-
-
 class Raylet:
     def __init__(self, config: SystemConfig, node_id: str, session_dir: str,
                  gcs_address: str, resources: Dict[str, float],
@@ -302,19 +218,18 @@ class Raylet:
             float(object_store_memory or config.object_store_memory_bytes))
         if self.total_resources["TPU"] == 0:
             self.total_resources.pop("TPU")
-        self.available = dict(self.total_resources)
         self.tpu_info = detect_tpu_topology()
-        self.free_chips: List[int] = list(range(int(num_tpus)))
-        # placement group reservations: (pg_id, bundle_index) -> resources.
-        # TPU demands reserve *concrete chip IDs* at prepare time (reference:
-        # placement_group_resource_manager.cc converts bundle resources into
-        # node-local instances) — two committed bundles own disjoint chip
-        # sets, and non-PG tasks can never drain a bundle's reserved chips.
-        self.prepared_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
-        self.committed_bundles: Dict[Tuple[str, int], Dict[str, float]] = {}
-        self.pg_available: Dict[Tuple[str, int], Dict[str, float]] = {}
-        self.prepared_bundle_chips: Dict[Tuple[str, int], List[int]] = {}
-        self.pg_chips: Dict[Tuple[str, int], List[int]] = {}
+        # The scheduling ledger owns ALL resource accounting and the
+        # pending-task queues: the node pool, per-PG-bundle pools
+        # (prepare/commit 2-phase, reference: node_manager.proto:377-384),
+        # concrete TPU chip IDs (two committed bundles own disjoint chip
+        # sets; reference: placement_group_resource_manager.cc), and the
+        # per-scheduling-class dispatch queues.  Backed by the C++
+        # schedcore (src/schedcore/schedcore.cc — the dispatch hot loop
+        # in native code, reference: local_task_manager.cc:99) with a
+        # pure-Python fallback.
+        self.led = make_ledger(self.total_resources,
+                               list(range(int(num_tpus))))
 
         store_path = os.path.join("/dev/shm" if os.path.isdir("/dev/shm")
                                   else session_dir,
@@ -384,7 +299,6 @@ class Raylet:
 
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}  # keyed by env hash
-        self.pending = PendingQueue()
         self._spilling_classes: set = set()
         self._peer_raylets: Dict[str, Any] = {}
         self.gcs: Optional[protocol.Connection] = None
@@ -753,90 +667,16 @@ class Raylet:
 
     # ------------------------------------------------------------ scheduling
 
-    def _bundle_key(self, spec) -> Optional[Tuple[str, int]]:
-        pg = spec.get("placement_group")
-        if not pg:
-            return None
-        return (pg["pg_id"], pg.get("bundle_index", 0))
-
-    def _resources_feasible(self, ptask: PendingTask) -> bool:
-        key = self._bundle_key(ptask.spec)
-        if key is not None:
-            pool = self.pg_available.get(key)
-            if pool is None:
-                return False
-            return all(pool.get(k, 0) + 1e-9 >= v
-                       for k, v in ptask.demand.items() if k != "TPU") and \
-                len(self.pg_chips.get(key, ())) >= ptask.tpu_demand
-        for k, v in ptask.demand.items():
-            if self.available.get(k, 0) + 1e-9 < v:
-                return False
-        # invariant: available["TPU"] == len(free_chips); check both anyway so
-        # feasibility can never say yes while the concrete chip pool is short
-        # (the round-2 PG race: return_bundle credited TPU counts for chips
-        # still held by an in-flight PG task).
-        return len(self.free_chips) >= ptask.tpu_demand
-
-    def _acquire_resources(
-            self, ptask: PendingTask) -> Optional[Tuple[int, ...]]:
-        """Atomically acquire demand + concrete chips, or return None.
-
-        Never returns a short chip tuple: either the full demand (including
-        ``tpu_demand`` concrete chip IDs) is covered, or nothing is taken.
-        Callers must treat None as "not feasible right now" and requeue.
-        """
-        key = self._bundle_key(ptask.spec)
-        if key is not None:
-            pool = self.pg_available.get(key)
-            if pool is None:  # bundle returned while the task waited
-                return None
-            chip_src = self.pg_chips.setdefault(key, [])
-        else:
-            pool = self.available
-            chip_src = self.free_chips
-        if len(chip_src) < ptask.tpu_demand:
-            return None
-        for k, v in ptask.demand.items():
-            if pool.get(k, 0) + 1e-9 < v:
-                return None
-        for k, v in ptask.demand.items():
-            pool[k] = pool.get(k, 0) - v
-        chips = tuple(chip_src[:ptask.tpu_demand])
-        del chip_src[:ptask.tpu_demand]
-        return chips
-
     def _release_resources(self, ptask: PendingTask,
                            chips: Tuple[int, ...] = ()):
         # freed capacity may unblock a pending task on every release path
         self._dispatch_event.set()
         self.report_soon()
-        key = self._bundle_key(ptask.spec)
-        if key is not None:
-            pool = self.pg_available.get(key)
-            if pool is not None:
-                for k, v in ptask.demand.items():
-                    pool[k] = pool.get(k, 0) + v
-                chip_dst = self.pg_chips.setdefault(key, [])
-                chip_dst.extend(chips)
-                chip_dst.sort()
-            else:
-                # Bundle already returned: chips rejoin the NODE pool, and the
-                # node's TPU count must follow them here (return_bundle only
-                # credited the chips it physically got back).
-                self.free_chips.extend(chips)
-                self.free_chips.sort()
-                self.available["TPU"] = \
-                    self.available.get("TPU", 0) + len(chips)
-            return
-        for k, v in ptask.demand.items():
-            self.available[k] = self.available.get(k, 0) + v
-        self.free_chips.extend(chips)
-        self.free_chips.sort()
+        self.led.release(ptask, chips)
 
     def _infeasible(self, ptask: PendingTask) -> bool:
         """Can this node EVER satisfy the demand?"""
-        key = self._bundle_key(ptask.spec)
-        if key is not None:
+        if bundle_key_of(ptask.spec) is not None:
             return False  # bundle is (or will be) here; wait
         for k, v in ptask.demand.items():
             if self.total_resources.get(k, 0) < v:
@@ -867,7 +707,7 @@ class Raylet:
                                               force=self._infeasible(ptask))
             if spill is not None:
                 return spill
-        self.pending.append(ptask)
+        self.led.append(ptask)
         self._dispatch_event.set()
         return await fut
 
@@ -914,11 +754,11 @@ class Raylet:
                         if not pt.reply_fut.done():
                             pt.reply_fut.set_result(spill)
                         return
-                    self.pending.append(pt)
+                    self.led.append(pt)
                     self._dispatch_event.set()
                 protocol.spawn(_spill())
             else:
-                self.pending.append(ptask)
+                self.led.append(ptask)
             accepted += 1
         self._dispatch_event.set()
         return {"accepted": accepted}
@@ -988,25 +828,23 @@ class Raylet:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
             now = time.monotonic()
-            for cls, q in self.pending.class_queues():
-                while q:
-                    ptask = q[0]
-                    if not self._resources_feasible(ptask):
-                        # try spillback for plain tasks stuck too long
-                        if now - ptask.submitted_at > 1.0 and \
-                                cls not in self._spilling_classes and \
-                                not ptask.spec.get("spilled_from") and \
-                                not ptask.spec.get("placement_group"):
-                            self._spilling_classes.add(cls)
-                            protocol.spawn(
-                                self._spillback_class(cls))
-                        break
-                    chips = self._acquire_resources(ptask)
-                    if chips is None:
-                        break
-                    self.pending.popleft_from(q)
-                    protocol.spawn(
-                        self._dispatch(ptask, chips))
+            # one ledger poll atomically acquires resources for every
+            # dispatchable class head (batched in C++ when native)
+            dispatches, blocked, more = self.led.poll()
+            for ptask, chips in dispatches:
+                protocol.spawn(self._dispatch(ptask, chips))
+            for ptask in blocked:
+                # try spillback for plain tasks stuck too long
+                cls = ptask.sched_class
+                if now - ptask.submitted_at > 1.0 and \
+                        cls not in self._spilling_classes and \
+                        not ptask.spec.get("spilled_from") and \
+                        not ptask.spec.get("placement_group"):
+                    self._spilling_classes.add(cls)
+                    protocol.spawn(self._spillback_class(cls))
+            if more:
+                self._dispatch_event.set()
+                await asyncio.sleep(0)  # let dispatches make progress
 
     async def _spillback_class(self, cls):
         """Drain a stuck scheduling class to other nodes: keep asking the
@@ -1019,15 +857,14 @@ class Raylet:
         if the move fails. One drainer per class at a time."""
         try:
             while not self._shutdown:
-                q = self.pending._classes.get(cls)
-                if not q:
+                head = self.led.head(cls)
+                if head is None:
                     return
-                head = q[0]
-                if self._resources_feasible(head) or \
+                if self.led.feasible(head) or \
                         head.spec.get("spilled_from") or \
                         head.spec.get("placement_group"):
                     return
-                self.pending.popleft_from(q)
+                self.led.pop_head(cls)
                 try:
                     reply = await self._try_spillback(head, force=False)
                 except Exception:
@@ -1036,7 +873,7 @@ class Raylet:
                     # nowhere to go: requeue at the front, re-arm the
                     # stuck timer so the probe isn't hot
                     head.submitted_at = time.monotonic()
-                    self.pending.requeue_front(head)
+                    self.led.requeue_front(head)
                     return
                 if head.reply_fut is not None and \
                         not head.reply_fut.done():
@@ -1142,9 +979,9 @@ class Raylet:
 
     async def handle_cancel_task(self, payload, conn):
         task_id = payload["task_id"]
-        for pt in self.pending:
+        for pt in self.led.pending_tasks():
             if pt.spec["task_id"] == task_id:
-                self.pending.remove(pt)
+                self.led.remove(pt)
                 if not pt.reply_fut.done():
                     pt.reply_fut.set_result({"error": "CANCELLED"})
                 return {"cancelled": "queued"}
@@ -1172,7 +1009,7 @@ class Raylet:
                              "placement_group": spec.get("placement_group"),
                              "task_id": "actor-" + payload["actor_id"],
                              "scheduling": {}}, None)
-        chips = self._acquire_resources(ptask)
+        chips = self.led.acquire(ptask)
         if chips is None:
             return {"error": "insufficient resources", "retryable": True}
         try:
@@ -1213,82 +1050,44 @@ class Raylet:
 
     # --------------------------------------------------------------- bundles
 
+    # The 2-phase bundle protocol is implemented by the ledger (C++
+    # schedcore / Python fallback): prepare deducts the node pool and
+    # reserves concrete chips; commit turns the reservation into a
+    # per-bundle pool; return credits non-TPU resources in full but only
+    # physically-free chips (chips held by a still-running PG task come
+    # home via release — the round-2 race fix).  All four handlers are
+    # idempotent under GCS-restart retries.
+
     async def handle_prepare_bundle(self, payload, conn):
-        key = (payload["pg_id"], payload["bundle_index"])
-        # idempotent under GCS-restart retries: this bundle's reservation
-        # already exists — re-deducting would leak resources/chips
-        if key in self.prepared_bundles or key in self.committed_bundles:
-            return {"ok": True}
-        res = payload["resources"]
-        n_tpu = int(res.get("TPU", 0))
-        for k, v in res.items():
-            if self.available.get(k, 0) + 1e-9 < v:
-                return {"ok": False}
-        if len(self.free_chips) < n_tpu:
-            return {"ok": False}
-        for k, v in res.items():
-            self.available[k] = self.available.get(k, 0) - v
-        # reserve concrete chips now so the bundle owns a disjoint set
-        self.prepared_bundle_chips[key] = self.free_chips[:n_tpu]
-        del self.free_chips[:n_tpu]
-        self.prepared_bundles[key] = res
-        return {"ok": True}
+        ok = self.led.prepare_bundle(
+            (payload["pg_id"], payload["bundle_index"]),
+            payload["resources"])
+        return {"ok": ok}
 
     async def handle_commit_bundle(self, payload, conn):
-        key = (payload["pg_id"], payload["bundle_index"])
-        if key in self.committed_bundles:
-            return {"ok": True}  # idempotent retry
-        res = self.prepared_bundles.pop(key, None)
-        if res is None:
-            return {"ok": False}
-        self.committed_bundles[key] = res
-        self.pg_available[key] = dict(res)
-        self.pg_chips[key] = self.prepared_bundle_chips.pop(key, [])
-        self._dispatch_event.set()
-        return {"ok": True}
+        ok = self.led.commit_bundle(
+            (payload["pg_id"], payload["bundle_index"]))
+        if ok:
+            self._dispatch_event.set()
+        return {"ok": ok}
 
     async def handle_cancel_bundle(self, payload, conn):
-        key = (payload["pg_id"], payload["bundle_index"])
-        res = self.prepared_bundles.pop(key, None)
-        if res is not None:
-            for k, v in res.items():
-                self.available[k] = self.available.get(k, 0) + v
-            self.free_chips.extend(self.prepared_bundle_chips.pop(key, []))
-            self.free_chips.sort()
+        self.led.cancel_bundle((payload["pg_id"], payload["bundle_index"]))
         return {"ok": True}
 
     async def handle_return_bundle(self, payload, conn):
         key = (payload["pg_id"], payload["bundle_index"])
-        res = self.committed_bundles.pop(key, None)
-        self.pg_available.pop(key, None)
-        if res is not None:
-            returned = self.pg_chips.pop(key, [])
-            for k, v in res.items():
-                if k == "TPU":
-                    continue
-                self.available[k] = self.available.get(k, 0) + v
-            # Only chips physically back in hand rejoin the node pool (and its
-            # TPU count) now; chips held by a still-running task of this PG
-            # come back — and re-credit available["TPU"] — via
-            # _release_resources when that task finishes. Crediting the full
-            # bundle count here let a waiting non-PG task pass feasibility and
-            # acquire an empty chip tuple (round-2 race).
-            self.free_chips.extend(returned)
-            self.free_chips.sort()
-            if "TPU" in res:
-                self.available["TPU"] = \
-                    self.available.get("TPU", 0) + len(returned)
-        # tasks still queued against this PG can never run now — fail them
-        pg_id = payload["pg_id"]
-        doomed = [pt for pt in self.pending
-                  if (pt.spec.get("placement_group") or {}).get("pg_id")
-                  == pg_id]
-        for pt in doomed:
-            self.pending.remove(pt)
+        self.led.return_bundle(key)
+        # tasks queued against ANY bundle of this PG can never run now
+        # (a task can queue for a sibling bundle this node never
+        # hosted — the removed PG's return_bundle would never arrive
+        # for it here); fail them all and free the scheduling classes
+        for pt in self.led.drain_pg(payload["pg_id"]):
             if pt.reply_fut is not None and not pt.reply_fut.done():
                 pt.reply_fut.set_result({
                     "error": "PLACEMENT_GROUP_REMOVED",
-                    "message": f"placement group {pg_id} was removed",
+                    "message":
+                        f"placement group {payload['pg_id']} was removed",
                 })
         self._dispatch_event.set()
         return {"ok": True}
@@ -1695,11 +1494,11 @@ class Raylet:
         return {
             "node_id": self.node_id,
             "resources": self.total_resources,
-            "available": self.available,
+            "available": self.led.snapshot(),
             "store": self.store.stats(),
             "num_spilled_objects": len(self.spilled),
             "num_workers": len(self.workers),
-            "num_pending_tasks": len(self.pending),
+            "num_pending_tasks": self.led.pending_count(),
             "tpu": self.tpu_info,
         }
 
@@ -1756,7 +1555,7 @@ class Raylet:
             "node_id": self.node_id,
             "physical": self._physical_stats(),
             "scheduler": {
-                "tasks_pending": len(self.pending),
+                "tasks_pending": self.led.pending_count(),
                 "tasks_running": running,
                 "tasks_dispatched_total": self._tasks_dispatched_total,
                 "tasks_spilled_back_total": self._tasks_spilled_back_total,
@@ -1764,7 +1563,7 @@ class Raylet:
                 "workers_idle": idle,
                 "actors_alive": actors,
                 "resources_total": dict(self.total_resources),
-                "resources_available": dict(self.available),
+                "resources_available": self.led.snapshot(),
                 # versioned sync stream position (ray_syncer analogue)
                 "sync_version": self._sync_version,
                 "known_view_version": self._known_view_version,
@@ -1785,7 +1584,7 @@ class Raylet:
             },
             "tpu": {
                 "num_chips": int(self.total_resources.get("TPU", 0)),
-                "chips_available": int(self.available.get("TPU", 0)),
+                "chips_available": int(self.led.avail_get("TPU")),
                 **(self.tpu_info or {}),
             },
         }
@@ -1958,7 +1757,7 @@ class Raylet:
         try:
             reply = await self.gcs.call("resource_report", {
                 "node_id": self.node_id,
-                "available": self.available,
+                "available": self.led.snapshot(),
                 "total": self.total_resources,
                 "sync_epoch": self._sync_epoch,
                 "sync_version": self._sync_version,

@@ -1,0 +1,351 @@
+"""Unit tests for the scheduling ledger — the C++ schedcore
+(src/schedcore/schedcore.cc) and the pure-Python fallback, driven
+through the same interface and asserted to behave identically
+(reference analogue: cluster_task_manager_test.cc + the fixed-point
+resource tests under src/ray/raylet/scheduling/)."""
+
+import pytest
+
+from ray_tpu._private.sched import (
+    NativeLedger, PendingTask, PyLedger, _lib, make_ledger)
+
+
+def _pt(demand, pg=None, spilled=False):
+    spec = {"resources": demand, "task_id": "t"}
+    if pg:
+        spec["placement_group"] = pg
+    if spilled:
+        spec["spilled_from"] = "other"
+    return PendingTask(spec, None)
+
+
+LEDGERS = [PyLedger]
+if _lib() is not None:
+    LEDGERS.append(NativeLedger)
+
+
+@pytest.fixture(params=LEDGERS, ids=lambda c: c.__name__)
+def led(request):
+    return request.param({"CPU": 4.0, "TPU": 4.0, "memory": 1e9},
+                         [0, 1, 2, 3])
+
+
+def test_native_lib_builds():
+    # the C++ core must actually be present in this environment
+    assert _lib() is not None
+    assert make_ledger({"CPU": 1.0}, []).native
+
+
+def test_acquire_release_roundtrip(led):
+    pt = _pt({"CPU": 2.0, "TPU": 2})
+    assert led.feasible(pt)
+    chips = led.acquire(pt)
+    assert chips == (0, 1)
+    assert led.avail_get("CPU") == pytest.approx(2.0)
+    assert led.avail_get("TPU") == pytest.approx(2.0)
+    # second acquire takes the remaining chips
+    pt2 = _pt({"CPU": 2.0, "TPU": 2})
+    assert led.acquire(pt2) == (2, 3)
+    assert not led.feasible(_pt({"CPU": 0.5}))  # CPU exhausted... no: 0 left
+    led.release(pt, chips)
+    assert led.avail_get("CPU") == pytest.approx(2.0)
+    assert led.feasible(_pt({"TPU": 2}))
+    led.release(pt2, (2, 3))
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+    assert led.node_chips_count() == 4
+
+
+def test_fractional_cpu_no_epsilon_drift(led):
+    # 40 x 0.1 CPU must exactly exhaust 4.0 CPU (fixed-point in native)
+    tasks = [_pt({"CPU": 0.1}) for _ in range(40)]
+    for t in tasks:
+        assert led.acquire(t) == ()
+    assert not led.feasible(_pt({"CPU": 0.1}))
+    for t in tasks:
+        led.release(t, ())
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+
+
+def test_queue_poll_dispatches_in_fifo(led):
+    pts = [_pt({"CPU": 1.0}) for _ in range(6)]
+    for p in pts:
+        led.append(p)
+    assert led.pending_count() == 6
+    dispatches, blocked, more = led.poll()
+    got = [p for p, _ in dispatches]
+    assert got == pts[:4]              # capacity for 4 CPUs
+    assert blocked and blocked[0] is pts[4]
+    assert led.pending_count() == 2
+    led.release(pts[0], ())
+    dispatches, _, _ = led.poll()
+    assert [p for p, _ in dispatches] == [pts[4]]
+
+
+def test_poll_blocked_class_does_not_starve_other_class(led):
+    big = _pt({"CPU": 64.0})
+    small = _pt({"CPU": 1.0})
+    led.append(big)
+    led.append(small)
+    dispatches, blocked, _ = led.poll()
+    assert [p for p, _ in dispatches] == [small]
+    assert blocked == [big]
+
+
+def test_remove_and_requeue(led):
+    a, b = _pt({"CPU": 1.0}), _pt({"CPU": 1.0})
+    led.append(a)
+    led.append(b)
+    assert led.remove(a)
+    assert not led.remove(a)
+    head = led.pop_head(b.sched_class)
+    assert head is b
+    led.requeue_front(b)
+    assert led.head(b.sched_class) is b
+    assert led.pending_count() == 1
+    assert led.pending_tasks() == [b]
+
+
+def test_bundle_lifecycle(led):
+    key = ("pg1", 0)
+    assert led.prepare_bundle(key, {"CPU": 2.0, "TPU": 2})
+    assert led.has_bundle(key)
+    assert led.avail_get("CPU") == pytest.approx(2.0)
+    assert led.node_chips_count() == 2
+    # idempotent prepare
+    assert led.prepare_bundle(key, {"CPU": 2.0, "TPU": 2})
+    assert led.avail_get("CPU") == pytest.approx(2.0)
+    assert led.commit_bundle(key)
+    assert led.commit_bundle(key)  # idempotent
+    # PG task draws from the bundle pool, not the node
+    pt = _pt({"CPU": 1.0, "TPU": 1},
+             pg={"pg_id": "pg1", "bundle_index": 0})
+    chips = led.acquire(pt)
+    assert chips == (0,)
+    assert led.avail_get("CPU") == pytest.approx(2.0)  # node untouched
+    # return while the task still runs: only the free chip rejoins
+    assert led.return_bundle(key)
+    assert led.node_chips_count() == 3
+    assert led.avail_get("TPU") == pytest.approx(3.0)
+    assert led.avail_get("CPU") == pytest.approx(4.0)  # non-TPU in full
+    # late release: chip + TPU count come home, nothing else
+    led.release(pt, chips)
+    assert led.node_chips_count() == 4
+    assert led.avail_get("TPU") == pytest.approx(4.0)
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+
+
+def test_bundle_cancel_restores(led):
+    key = ("pg2", 0)
+    assert led.prepare_bundle(key, {"CPU": 1.0, "TPU": 4})
+    assert led.cancel_bundle(key)
+    assert not led.has_bundle(key)
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+    assert led.node_chips_count() == 4
+    assert not led.cancel_bundle(key)
+
+
+def test_bundle_task_infeasible_until_commit(led):
+    pt = _pt({"CPU": 1.0}, pg={"pg_id": "pg3", "bundle_index": 0})
+    assert not led.feasible(pt)
+    led.append(pt)
+    _, blocked, _ = led.poll()
+    assert blocked == [pt]
+    assert led.prepare_bundle(("pg3", 0), {"CPU": 1.0})
+    assert not led.feasible(pt)          # still only prepared
+    assert led.commit_bundle(("pg3", 0))
+    dispatches, _, _ = led.poll()
+    assert [p for p, _ in dispatches] == [pt]
+
+
+def test_release_after_bundle_gone_credits_node_chips_only(led):
+    key = ("pg4", 0)
+    led.prepare_bundle(key, {"CPU": 2.0, "TPU": 2})
+    led.commit_bundle(key)
+    pt = _pt({"CPU": 2.0, "TPU": 2}, pg={"pg_id": "pg4", "bundle_index": 0})
+    chips = led.acquire(pt)
+    assert chips == (0, 1)
+    led.return_bundle(key)
+    cpu_before = led.avail_get("CPU")
+    led.release(pt, chips)
+    # CPU unchanged (was credited at return); chips + TPU count restored
+    assert led.avail_get("CPU") == pytest.approx(cpu_before)
+    assert led.avail_get("TPU") == pytest.approx(4.0)
+    assert led.node_chips_count() == 4
+
+
+def test_spilled_tasks_get_own_class(led):
+    plain = _pt({"CPU": 1.0})
+    spilled = _pt({"CPU": 1.0}, spilled=True)
+    assert plain.sched_class != spilled.sched_class
+
+
+def test_snapshot_reports_totals_keys(led):
+    snap = led.snapshot()
+    assert snap["CPU"] == pytest.approx(4.0)
+    assert snap["TPU"] == pytest.approx(4.0)
+    assert snap["memory"] == pytest.approx(1e9)
+
+
+def test_drain_bundle_pops_tasks_and_frees_classes(led):
+    key = ("pgd", 0)
+    led.prepare_bundle(key, {"CPU": 1.0})
+    led.commit_bundle(key)
+    pts = [_pt({"CPU": 1.0}, pg={"pg_id": "pgd", "bundle_index": 0})
+           for _ in range(3)]
+    for p in pts:
+        led.append(p)
+    led.return_bundle(key)
+    drained = led.drain_bundle(key)
+    assert set(id(p) for p in drained) == set(id(p) for p in pts)
+    assert led.pending_count() == 0
+    # the same (pg, bundle) key re-interns cleanly afterwards
+    pt2 = _pt({"CPU": 1.0}, pg={"pg_id": "pgd", "bundle_index": 0})
+    assert not led.feasible(pt2)  # no pool anymore
+    led.append(pt2)
+    _, blocked, _ = led.poll()
+    assert blocked == [pt2]
+    assert led.drain_bundle(("never", 9)) == []
+
+
+def test_release_after_drain_credits_node(led):
+    # a task still running when its bundle is returned AND drained:
+    # release must land in the node pool (chips + TPU count only)
+    key = ("pgr", 0)
+    led.prepare_bundle(key, {"CPU": 1.0, "TPU": 1})
+    led.commit_bundle(key)
+    pt = _pt({"CPU": 1.0, "TPU": 1},
+             pg={"pg_id": "pgr", "bundle_index": 0})
+    chips = led.acquire(pt)
+    assert chips == (0,)
+    led.return_bundle(key)
+    led.drain_bundle(key)
+    led.release(pt, chips)
+    assert led.node_chips_count() == 4
+    assert led.avail_get("TPU") == pytest.approx(4.0)
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+
+
+def test_blocked_reporting_rotates_over_many_classes():
+    # >POLL_MAXBLOCKED blocked classes: every class must surface in the
+    # blocked report within a bounded number of polls (spillback must
+    # eventually see each stuck class)
+    from ray_tpu._private import sched as sched_mod
+    if _lib() is None:
+        pytest.skip("native lib unavailable")
+    led = NativeLedger({"CPU": 0.0}, [])
+    n = sched_mod.POLL_MAXBLOCKED + 40
+    pts = [_pt({"CPU": 1.0, f"u{i}": 0.0}) for i in range(n)]
+    for p in pts:
+        led.append(p)
+    seen = set()
+    for _ in range(6):
+        _, blocked, _ = led.poll()
+        seen.update(id(p) for p in blocked)
+    assert len(seen) == n
+
+
+def test_drain_pg_covers_unhosted_sibling_bundles(led):
+    # task queued for bundle 1 of a PG whose bundle 0 lives here: PG
+    # removal must doom it even though return_bundle((pg,1)) never
+    # arrives on this node
+    led.prepare_bundle(("pgs", 0), {"CPU": 1.0})
+    led.commit_bundle(("pgs", 0))
+    sibling = _pt({"CPU": 1.0}, pg={"pg_id": "pgs", "bundle_index": 1})
+    led.append(sibling)
+    led.return_bundle(("pgs", 0))
+    drained = led.drain_pg("pgs")
+    assert sibling in drained
+    assert led.pending_count() == 0
+
+
+def test_tiny_fractional_demand_blocks_when_resource_absent(led):
+    # sub-granularity demands must not round to "free" (native ledger
+    # rounds demands UP at 1/10000 fixed-point)
+    pt = _pt({"CPU": 1.0, "nonexistent": 4e-05})
+    assert not led.feasible(pt)
+    assert led.acquire(pt) is None
+
+
+def test_class_interning_bounded_under_demand_churn():
+    if _lib() is None:
+        pytest.skip("native lib unavailable")
+    led = NativeLedger({"CPU": 64.0}, [])
+    for i in range(3000):
+        p = _pt({"CPU": 0.0001 * (i + 1)})
+        led.append(p)
+        dispatches, _, _ = led.poll()
+        for q, chips in dispatches:
+            led.release(q, chips)
+    # far fewer live interning entries than distinct demands seen
+    assert len(led._cls_ids) <= 2 * led._GC_THRESHOLD
+    assert led.avail_get("CPU") == pytest.approx(64.0)
+
+
+def test_sibling_bundle_return_after_drain_pg_restores_node(led):
+    # two committed bundles of one PG on this node; PG removal sends
+    # return_bundle per bundle, and the FIRST one's handler runs a
+    # pg-wide drain — the second bundle's return must still find its
+    # pool and restore the node in full (the drain-orphaned-pool leak)
+    led.prepare_bundle(("pg2b", 0), {"CPU": 1.0, "TPU": 2})
+    led.commit_bundle(("pg2b", 0))
+    led.prepare_bundle(("pg2b", 1), {"CPU": 1.0, "TPU": 2})
+    led.commit_bundle(("pg2b", 1))
+    led.return_bundle(("pg2b", 0))
+    led.drain_pg("pg2b")
+    assert led.return_bundle(("pg2b", 1))
+    led.drain_pg("pg2b")
+    assert led.avail_get("CPU") == pytest.approx(4.0)
+    assert led.avail_get("TPU") == pytest.approx(4.0)
+    assert led.node_chips_count() == 4
+
+
+def test_one_third_cpu_packs_three_per_core(led):
+    # fixed-point rounding must keep float-ledger parity for
+    # non-representable fractions: 3 x 1/3 fit on 1.0 CPU
+    third = 1.0 / 3.0
+    taken = []
+    for _ in range(12):
+        p = _pt({"CPU": third})
+        if led.acquire(p) is not None:
+            taken.append(p)
+    assert len(taken) == 12  # 4 CPUs x 3 per core
+    assert led.acquire(_pt({"CPU": third})) is None
+    for p in taken:
+        led.release(p, ())
+    assert led.avail_get("CPU") == pytest.approx(4.0, abs=1e-3)
+
+
+def test_oversize_tpu_demand_reports_blocked_not_spin():
+    from ray_tpu._private import sched as sched_mod
+    if _lib() is None:
+        pytest.skip("native lib unavailable")
+    led = NativeLedger({"CPU": 1.0, "TPU": 8000.0},
+                       list(range(8000)))
+    pt = _pt({"TPU": 6000})  # exceeds POLL_MAXCHIPS
+    led.append(pt)
+    for _ in range(3):
+        dispatches, blocked, more = led.poll()
+        assert not dispatches
+        assert blocked == [pt]   # visible to spillback policy
+        assert not more          # must not busy-spin the loop
+
+
+def test_poll_many_classes_many_tasks(led):
+    # drain 300 tasks across 3 classes through repeated poll/release
+    all_pts = []
+    for i in range(100):
+        for d in ({"CPU": 1.0}, {"CPU": 0.5}, {"CPU": 2.0}):
+            p = _pt(dict(d))
+            all_pts.append(p)
+            led.append(p)
+    done = []
+    for _ in range(1000):
+        dispatches, blocked, more = led.poll()
+        if not dispatches and not more:
+            if led.pending_count() == 0:
+                break
+        for p, chips in dispatches:
+            done.append(p)
+            led.release(p, chips)
+    assert len(done) == 300
+    assert led.avail_get("CPU") == pytest.approx(4.0)
