@@ -17,3 +17,6 @@ from ray_tpu.train.torch_trainer import (  # noqa: F401
     TorchConfig, TorchTrainer, prepare_data_loader, prepare_model)
 from ray_tpu.train.transformers_trainer import (  # noqa: F401
     HuggingFaceTrainer, TransformersTrainer)
+from ray_tpu.train.sklearn_trainer import SklearnTrainer  # noqa: F401
+from ray_tpu.train.lightning_trainer import LightningTrainer  # noqa: F401
+from ray_tpu.train.rl_trainer import RLTrainer  # noqa: F401
