@@ -30,6 +30,7 @@ from ray_tpu.rllib.algorithms.alpha_zero import (AlphaZero,
                                                  AlphaZeroConfig)
 from ray_tpu.rllib.algorithms.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rllib.algorithms.maml import MAML, MAMLConfig
+from ray_tpu.rllib.algorithms.mbmpo import MBMPO, MBMPOConfig
 from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig
 
 __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
@@ -48,4 +49,5 @@ __all__ = ["PPO", "PPOConfig", "DDPPO", "DDPPOConfig", "DQN",
            "MADDPG", "MADDPGConfig",
            "AlphaStar", "AlphaStarConfig",
            "AlphaZero", "AlphaZeroConfig", "Dreamer", "DreamerConfig",
-           "MAML", "MAMLConfig", "SlateQ", "SlateQConfig"]
+           "MAML", "MAMLConfig", "MBMPO", "MBMPOConfig",
+           "SlateQ", "SlateQConfig"]

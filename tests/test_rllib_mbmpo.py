@@ -1,0 +1,34 @@
+"""MBMPO learning test (reference: rllib/algorithms/mbmpo/ — model
+ensemble + MAML over ensemble members as tasks)."""
+
+import numpy as np
+
+
+def test_mbmpo_learns_point_goal():
+    from ray_tpu.rllib.algorithms import MBMPO
+
+    algo = MBMPO(config={
+        "seed": 0,
+        "ensemble_size": 3,
+        "real_episodes_per_iter": 12,
+        "imagined_episodes": 12,
+        "model_train_iters": 40,
+        "horizon": 20,
+        "lr": 3e-3,
+    })
+    try:
+        first = algo.train()
+        assert np.isfinite(first["model_loss"])
+        best = -np.inf
+        for _ in range(14):
+            res = algo.train()
+            best = max(best, res["real_reward_mean"])
+            # model must actually fit the simple dynamics
+            if res["model_loss"] < 1e-3 and best > -12.0:
+                break
+        # random policy on point_goal scores ~ -19 (distance ~1 per
+        # step over 20 steps); meta-trained + model-planned must beat it
+        assert best > -14.0, f"no learning progress: best={best}"
+        assert res["model_loss"] < 5e-2
+    finally:
+        algo.cleanup()
