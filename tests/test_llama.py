@@ -1,0 +1,88 @@
+"""Llama-family model tests: shape/loss sanity, GQA, long-context ring
+path compatibility, and NUMERIC parity against a locally-initialized HF
+LlamaForCausalLM through the checkpoint importer (no downloads — the HF
+model is randomly initialized in-process, exported, imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_tiny_llama_forward_and_loss():
+    from ray_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                      causal_lm_loss)
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 32)))
+    params = model.init(jax.random.PRNGKey(0), ids)
+    logits = jax.jit(model.apply)(params, ids)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    loss = causal_lm_loss(logits, ids)
+    # random init ≈ uniform: loss ~ log(vocab)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_gqa_heads_shared():
+    from ray_tpu.models.llama import LlamaConfig
+    cfg = LlamaConfig.tiny()
+    assert cfg.n_kv_heads < cfg.n_heads  # tiny config exercises GQA
+
+
+def test_llama_train_step_reduces_loss():
+    import optax
+
+    from ray_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                      causal_lm_loss)
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 16, (4, 32)))  # low-entropy data
+    params = model.init(jax.random.PRNGKey(0), ids)
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply(p, ids), ids))(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    first = None
+    for i in range(30):
+        params, state, loss = step(params, state, ids)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 0.5
+
+
+def test_hf_llama_import_numeric_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel, import_hf_llama
+
+    cfg = LlamaConfig(vocab_size=128, max_seq_len=64, dim=64, n_layers=2,
+                      n_heads=4, n_kv_heads=2, ffn_hidden=128,
+                      dtype=jnp.float32, attention_backend="reference")
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        attention_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)  # random init, no download
+    hf.eval()
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        hf.save_pretrained(d, safe_serialization=False)
+        variables = import_hf_llama(d, cfg)
+
+    ids_np = np.random.default_rng(0).integers(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids_np)).logits.numpy()
+    got = np.asarray(LlamaModel(cfg).apply(variables, jnp.asarray(ids_np)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
