@@ -156,10 +156,11 @@ def test_appo_learns_cartpole(cluster):
             .debugging(seed=0).build())
     assert algo._learner is not None  # async learner thread active
     best = 0.0
-    # 45 iters: the async learner's sample/update interleaving is
-    # timing-dependent under 1-core suite contention — 30 was observed
-    # to land at 57.5 once with the whole suite running
-    for _ in range(45):
+    # 70 iters: the async learner's sample/update interleaving is
+    # timing-dependent under 1-core suite contention — 45 was observed
+    # to land at 54-58 under a concurrently running full suite; the
+    # early break keeps converged runs at ~12-30 iters
+    for _ in range(70):
         r = algo.step()
         if not np.isnan(r["episode_reward_mean"]):
             best = max(best, r["episode_reward_mean"])
