@@ -57,9 +57,11 @@ _PEAK_BF16 = [
     ("v2", 45e12),
 ]
 
-# Larger scoped vmem helps the conv fusions on v5e (measured ~5-10% on this
-# box; harmless elsewhere).
-_LIBTPU_ARGS = "--xla_tpu_scoped_vmem_limit_kib=98304"
+# Round-4 re-measurement: the scoped-vmem override round 3 added
+# (--xla_tpu_scoped_vmem_limit_kib=98304) is a 5% REGRESSION on this
+# chip (111.2ms vs 105.7ms/step raw control, back-to-back) — the
+# compiler's default vmem budget wins, so every path strips
+# LIBTPU_INIT_ARGS from its subprocess env.
 
 READY_MARKER = "#BENCH_BACKEND_READY"
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", 300))
@@ -330,13 +332,12 @@ def _run_raw_control(force_cpu: bool):
     # (the round-1 failure mode this supervisor exists for)
     import threading
 
-    env = dict(os.environ, _BENCH_RAW="1",
-               LIBTPU_INIT_ARGS=_LIBTPU_ARGS)
+    env = dict(os.environ, _BENCH_RAW="1")
+    env.pop("LIBTPU_INIT_ARGS", None)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu/xla_cache")
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["_BENCH_FORCE_CPU"] = "1"
-        env.pop("LIBTPU_INIT_ARGS", None)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             stdout=subprocess.PIPE, text=True, env=env,
                             start_new_session=True)
@@ -468,11 +469,10 @@ def _attempt(force_cpu: bool):
     raw, err = _run_raw_control(force_cpu)
     if raw is None:
         return None, err
-    env = dict(os.environ, _BENCH_FRAMEWORK="1",
-               LIBTPU_INIT_ARGS=_LIBTPU_ARGS)
+    env = dict(os.environ, _BENCH_FRAMEWORK="1")
+    env.pop("LIBTPU_INIT_ARGS", None)
     if force_cpu:
         env["_BENCH_FORCE_CPU"] = "1"
-        env.pop("LIBTPU_INIT_ARGS", None)
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             stdout=subprocess.PIPE, text=True, env=env,
                             start_new_session=True)

@@ -15,4 +15,5 @@ from ray_tpu.tune.search.bohb import BOHBSearcher  # noqa: F401
 from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
 from ray_tpu.tune.search.bayesopt import BayesOptSearch  # noqa: F401
 from ray_tpu.tune.search.external import (  # noqa: F401
-    HyperOptSearch, OptunaSearch)
+    AxSearch, DragonflySearch, FLAMLSearch, HEBOSearch, HyperOptSearch,
+    NevergradSearch, OptunaSearch, SigOptSearch, SkOptSearch, ZOOptSearch)

@@ -1,0 +1,210 @@
+"""External searcher adapters driven through interface mocks of their
+backing libraries (reference: tune/search/{skopt,nevergrad,ax,flaml}
+integrations + SURVEY §4's mock strategy — none of these packages ship
+in this image, so the adapters are exercised against faked modules and
+the gates against the real absence)."""
+
+import sys
+import types
+
+import pytest
+
+from ray_tpu.tune.search import (AxSearch, DragonflySearch, FLAMLSearch,
+                                 HEBOSearch, NevergradSearch, SigOptSearch,
+                                 SkOptSearch, ZOOptSearch)
+from ray_tpu.tune import sample as s
+
+SPACE = {"lr": s.loguniform(1e-4, 1e-1), "depth": s.randint(1, 5),
+         "act": s.choice(["relu", "tanh"]), "fixed": 7}
+
+
+@pytest.mark.parametrize("cls", [SkOptSearch, NevergradSearch, AxSearch,
+                                 FLAMLSearch, ZOOptSearch, DragonflySearch,
+                                 SigOptSearch, HEBOSearch])
+def test_gates_raise_with_native_pointer(cls):
+    with pytest.raises(ImportError, match="built-in|BayesOptSearch|"
+                                          "TPESearcher"):
+        cls(space=SPACE, metric="score", mode="max") if cls in (
+            SkOptSearch, NevergradSearch, AxSearch, FLAMLSearch) else cls()
+
+
+class _FakeModule(types.ModuleType):
+    pass
+
+
+@pytest.fixture
+def fake_skopt(monkeypatch):
+    mod = _FakeModule("skopt")
+    space_mod = _FakeModule("skopt.space")
+
+    class _Dim:
+        def __init__(self, *a, **kw):
+            self.args, self.kw = a, kw
+
+    space_mod.Real = _Dim
+    space_mod.Integer = _Dim
+    space_mod.Categorical = _Dim
+
+    class _Optimizer:
+        def __init__(self, dims, random_state=None):
+            self.dims = dims
+            self.told = []
+            self._n = 0
+
+        def ask(self):
+            self._n += 1
+            # [lr, depth, act] in declaration order
+            return [0.01 * self._n, 2, "relu"]
+
+        def tell(self, x, loss):
+            self.told.append((list(x), loss))
+
+    mod.Optimizer = _Optimizer
+    mod.space = space_mod
+    monkeypatch.setitem(sys.modules, "skopt", mod)
+    monkeypatch.setitem(sys.modules, "skopt.space", space_mod)
+    return mod
+
+
+def test_skopt_ask_tell_roundtrip(fake_skopt):
+    searcher = SkOptSearch(space=SPACE, metric="score", mode="max", seed=0)
+    cfg = searcher.suggest("t1")
+    assert cfg["lr"] == pytest.approx(0.01)
+    assert cfg["depth"] == 2
+    assert cfg["act"] == "relu"
+    assert cfg["fixed"] == 7
+    searcher.on_trial_complete("t1", {"score": 0.9})
+    impl = searcher._impl
+    assert impl.told == [([0.01, 2, "relu"], -0.9)]  # max -> minimize flip
+    # error completions are dropped, not told
+    searcher.suggest("t2")
+    searcher.on_trial_complete("t2", error=True)
+    assert len(impl.told) == 1
+    # categorical dims got the category list
+    cats = [d for d in impl.dims if d.args and
+            isinstance(d.args[0], list) and "relu" in d.args[0]]
+    assert cats
+
+
+@pytest.fixture
+def fake_nevergrad(monkeypatch):
+    ng = _FakeModule("nevergrad")
+
+    class _Param:
+        def __init__(self, **kw):
+            self.kw = kw
+
+        def set_integer_casting(self):
+            self.integer = True
+            return self
+
+    class _Dict:
+        def __init__(self, **params):
+            self.params = params
+
+    class _Candidate:
+        def __init__(self, value):
+            self.value = value
+
+    class _Opt:
+        def __init__(self, parametrization=None, budget=None):
+            self.parametrization = parametrization
+            self.tells = []
+
+        def ask(self):
+            return _Candidate({"lr": 0.005, "depth": 3, "act": "tanh"})
+
+        def tell(self, cand, loss):
+            self.tells.append((cand.value, loss))
+
+    ng.p = types.SimpleNamespace(Choice=lambda c: _Param(choices=c),
+                                 Scalar=lambda **kw: _Param(**kw),
+                                 Log=lambda **kw: _Param(**kw),
+                                 Dict=_Dict)
+    ng.optimizers = types.SimpleNamespace(registry={"NGOpt": _Opt})
+    monkeypatch.setitem(sys.modules, "nevergrad", ng)
+    return ng
+
+
+def test_nevergrad_ask_tell_roundtrip(fake_nevergrad):
+    searcher = NevergradSearch(space=SPACE, metric="loss", mode="min")
+    cfg = searcher.suggest("t1")
+    assert cfg["lr"] == pytest.approx(0.005)
+    assert cfg["depth"] == 3
+    assert cfg["act"] == "tanh"
+    searcher.on_trial_complete("t1", {"loss": 1.25})
+    assert searcher._impl.tells[0][1] == pytest.approx(1.25)  # min: no flip
+
+
+@pytest.fixture
+def fake_flaml(monkeypatch):
+    flaml = _FakeModule("flaml")
+
+    class _Blend:
+        def __init__(self, metric=None, mode=None, space=None):
+            self.space = space
+            self.completed = []
+
+        def suggest(self, tid):
+            return {"lr": 0.02, "depth": 1, "act": "relu"}
+
+        def on_trial_complete(self, tid, result=None, error=False):
+            self.completed.append((tid, result, error))
+
+    flaml.BlendSearch = _Blend
+    monkeypatch.setitem(sys.modules, "flaml", flaml)
+    return flaml
+
+
+def test_flaml_adapter(fake_flaml):
+    searcher = FLAMLSearch(space=SPACE, metric="score", mode="max")
+    cfg = searcher.suggest("t1")
+    assert cfg["lr"] == pytest.approx(0.02)
+    searcher.on_trial_complete("t1", {"score": 2.0})
+    tid, result, error = searcher._impl.completed[0]
+    assert result == {"score": -2.0} and not error
+    # translated space carried log/int markers
+    assert searcher._impl.space["lr"]["log"] is True
+    assert searcher._impl.space["depth"]["int"] is True
+
+
+def test_num_samples_exhausts(fake_skopt):
+    searcher = SkOptSearch(space=SPACE, metric="m", mode="min",
+                           num_samples=2)
+    assert searcher.suggest("a") is not None
+    assert searcher.suggest("b") is not None
+    assert searcher.suggest("c") is None
+
+
+def test_quniform_normal_func_and_dotted_keys(fake_skopt):
+    # QUniform quantizes, Normal maps to a bounded range, sample_from
+    # rides through to resolve(), dotted keys survive round-trip
+    space = {"batch": s.quniform(32, 256, 32),
+             "noise": s.randn(0.0, 1.0),
+             "derived": s.sample_from(lambda: 11),
+             "opt.lr": s.uniform(0.0, 1.0)}
+    searcher = SkOptSearch(space=space, metric="m", mode="min")
+    cfg = searcher.suggest("t1")
+    assert cfg["batch"] % 32 == 0
+    assert isinstance(cfg["noise"], (int, float))  # mock feeds ints
+    assert cfg["derived"] == 11
+    assert "opt.lr" in cfg          # dotted key NOT exploded into nests
+
+
+def test_flaml_backoff_returns_none_without_consuming(fake_flaml):
+    class _Backoff(fake_flaml.BlendSearch):
+        def suggest(self, tid):
+            return None
+
+    fake_flaml.BlendSearch = _Backoff
+    searcher = FLAMLSearch(space=SPACE, metric="m", mode="min",
+                           num_samples=1)
+    assert searcher.suggest("t1") is None
+    assert searcher._suggested == 0  # budget not consumed on backoff
+
+
+def test_nevergrad_error_completion_dropped(fake_nevergrad):
+    searcher = NevergradSearch(space=SPACE, metric="m", mode="min")
+    searcher.suggest("t1")
+    searcher.on_trial_complete("t1", error=True)
+    assert searcher._impl.tells == []  # inf loss never told
