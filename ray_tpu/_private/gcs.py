@@ -113,6 +113,7 @@ class GcsServer:
             "_on_disconnect": self._on_disconnect,
             "register_node": self.register_node,
             "resource_report": self.resource_report,
+            "node_liveness": self.node_liveness,
             "get_nodes": self.get_nodes,
             "profile_stacks": self.profile_stacks,
             "get_node_stats": self.get_node_stats,
@@ -349,6 +350,20 @@ class GcsServer:
                                             "node_id": node_id,
                                             "resources": info.total_resources})
         return {"config": self.config.to_json()}
+
+    async def node_liveness(self, payload, conn):
+        """Thread-side heartbeat (see raylet._start_liveness_thread):
+        refreshes last_seen while the raylet's EVENT LOOP may be busy
+        with bulk work — a loaded node is not a dead node.  A loop
+        wedged past loop_stall_death_s stops counting as alive: the
+        beat attests the process, the lag bounds the loop."""
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {}
+        lag = float(payload.get("loop_lag_s", 0.0))
+        if node.alive and lag < self.config.loop_stall_death_s:
+            node.last_seen = time.monotonic()
+        return {}
 
     async def resource_report(self, payload, conn):
         node = self.nodes.get(payload["node_id"])

@@ -362,6 +362,8 @@ class Raylet:
         loop = asyncio.get_running_loop()
         protocol.spawn(self._dispatch_loop())
         protocol.spawn(self._report_loop())
+        protocol.spawn(self._loop_tick_task())
+        self._start_liveness_thread()
         protocol.spawn(self._idle_reaper_loop())
         protocol.spawn(self._log_monitor_loop())
         if self.config.memory_monitor_enabled:
@@ -1095,7 +1097,13 @@ class Raylet:
     # ---------------------------------------------------------- object plane
 
     async def handle_pull_object(self, payload, conn):
-        """Serve chunks of a local object to a remote raylet."""
+        """Serve chunks of a local object to a remote raylet.
+
+        The chunk copy runs in the executor: 20 concurrent 1 GiB pulls
+        are thousands of multi-MiB memcpys, and doing them inline
+        starves the event loop for tens of seconds (long enough that
+        in-loop heartbeats used to miss the GCS death timeout — the
+        full-size broadcast regression)."""
         oid = ObjectID.from_hex(payload["object_id"])
         buf = self.store.get_buffer(oid)
         if buf is None and oid.hex() in self.spilled:
@@ -1106,8 +1114,9 @@ class Raylet:
         try:
             offset = payload.get("offset", 0)
             n = min(payload.get("length", CHUNK), len(buf) - offset)
-            return {"found": True, "total_size": len(buf),
-                    "data": bytes(buf[offset:offset + n])}
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: bytes(buf[offset:offset + n]))
+            return {"found": True, "total_size": len(buf), "data": data}
         finally:
             buf.release()
             self.store.release(oid)
@@ -1160,9 +1169,24 @@ class Raylet:
         if oid.hex() in self.spilled:  # our own disk copy: restore, done
             if await self._restore_spilled(oid):
                 return
-        r = await self.gcs.call("get_object_locations",
-                                {"object_id": oid.hex()})
-        locs = [l for l in r["locations"] if l["node_id"] != self.node_id]
+        # an empty directory answer is retried with backoff: the entry
+        # may lag the put (location registration in flight) or be in a
+        # transient hole (a false node death purged it; the holder's
+        # next pin/report re-adds it) — failing the task on one empty
+        # read turns those windows into OBJECT_FETCH_FAILED storms
+        locs: list = []
+        for attempt in range(6):
+            r = await self.gcs.call("get_object_locations",
+                                    {"object_id": oid.hex()})
+            locs = [l for l in r["locations"]
+                    if l["node_id"] != self.node_id]
+            if locs or attempt == 5:
+                break
+            await asyncio.sleep(0.5 * (attempt + 1))
+        # one deadline for the WHOLE fetch (spanning both replica
+        # passes): each push-join below consumes from it rather than
+        # re-arming, so a fetch can never exceed the advertised bound
+        join_deadline = time.monotonic() + self.config.arg_fetch_timeout_s
         last_err = None
         # two passes: a replica skipped because a (then-live, since
         # reaped) inbound push held the slot deserves one retry
@@ -1199,15 +1223,26 @@ class Raylet:
                             buf = self.store.create(oid, total,
                                                     allow_fallback=True)
                         try:
+                            loop_ = asyncio.get_running_loop()
+
+                            def _write(dst_off, d):
+                                buf[dst_off:dst_off + len(d)] = d
+
                             data = first["data"]
-                            buf[:len(data)] = data
+                            # chunk writes run in the executor — a GiB
+                            # of inline memcpys stalls this raylet's
+                            # loop just like inline serving stalls the
+                            # holder's (see handle_pull_object)
+                            await loop_.run_in_executor(
+                                None, _write, 0, data)
                             got = len(data)
                             while got < total:
                                 chunk = await remote.call("pull_object", {
                                     "object_id": oid.hex(), "offset": got,
                                     "length": CHUNK})
                                 d = chunk["data"]
-                                buf[got:got + len(d)] = d
+                                await loop_.run_in_executor(
+                                    None, _write, got, d)
                                 got += len(d)
                         except BaseException:
                             # never leak an unsealed create: it would
@@ -1226,14 +1261,17 @@ class Raylet:
                     remote.close()
             except ValueError as e:
                 # a LIVE inbound push holds the slot (same-process
-                # fetches are deduped above): wait for its seal,
-                # reaping it if it goes stale so we can retry
-                for _ in range(120):
+                # fetches are deduped above): JOIN it — wait for its
+                # seal as long as chunks keep arriving (a GiB push at
+                # contended bandwidth takes minutes; a fixed short cap
+                # abandoned pushes that were making steady progress),
+                # reaping only a STALE push so the pull can take over
+                while time.monotonic() < join_deadline:
                     if self.store.contains(oid):
                         return
                     if self._abort_stale_push(oid.hex(), max_age=10.0):
                         break  # interrupted push reaped — retry pull
-                    await asyncio.sleep(0.25)
+                    await asyncio.sleep(0.5)
                 last_err = e
             except Exception as e:  # try next replica
                 last_err = e
@@ -1807,6 +1845,62 @@ class Raylet:
         while not self._shutdown:
             await self._send_report()
             await asyncio.sleep(self.config.health_check_period_s)
+
+    # ------------------------------------------------------------ liveness
+
+    async def _loop_tick_task(self):
+        """Stamp event-loop progress for the liveness thread: the lag
+        between now and this stamp is how far behind the loop is."""
+        period = max(0.25, self.config.health_check_period_s / 2)
+        while not self._shutdown:
+            self._loop_tick = time.monotonic()
+            await asyncio.sleep(period)
+
+    def _start_liveness_thread(self):
+        """Heartbeats from a DEDICATED thread + connection, so a busy
+        event loop cannot read as node death (the 1 GiB-broadcast
+        failure: the head raylet's loop spends >10s serving bulk pull
+        chunks, its in-loop report misses the GCS health timeout, the
+        GCS declares it dead and purges its object locations — every
+        reader then sees "no live copies" for an object that is sitting
+        pinned in shm).  The beat carries the loop's lag; a WEDGED loop
+        (lag > loop_stall_death_s) stops refreshing last_seen, so true
+        event-loop death is still detected — what this thread attests
+        is "process up, loop merely behind", which the reference gets
+        for free from its µs-latency C++ handlers
+        (gcs_heartbeat_manager.cc)."""
+        import threading
+
+        self._loop_tick = time.monotonic()
+        period = self.config.health_check_period_s
+
+        def run():
+            async def beat():
+                conn = None
+                while not self._shutdown:
+                    lag = time.monotonic() - self._loop_tick
+                    try:
+                        if conn is None or conn._closed:
+                            conn = await protocol.connect(self.gcs_address)
+                        await conn.call("node_liveness", {
+                            "node_id": self.node_id,
+                            "loop_lag_s": lag,
+                        }, timeout=period * 4)
+                    except Exception:
+                        if conn is not None:
+                            conn.close()  # a timed-out call leaves the
+                            conn = None   # socket open — don't leak it
+                    await asyncio.sleep(period)
+                if conn is not None:
+                    conn.close()
+
+            try:
+                asyncio.run(beat())
+            except Exception:
+                pass
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"liveness-{self.node_id[:8]}").start()
 
     def shutdown(self):
         self._shutdown = True

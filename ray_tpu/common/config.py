@@ -65,7 +65,17 @@ class SystemConfig:
     actor_max_restarts_default: int = 0
     lineage_max_bytes: int = 1024**3
     health_check_period_s: float = 1.0
-    health_check_timeout_s: float = 10.0
+    # Death window. The reference's GCS declares death only after a
+    # FAILURE STREAK of active probes (health_check_period 3s x
+    # failure_threshold 5 on top of a 10s probe timeout — i.e. tens of
+    # seconds), precisely so load spikes don't read as deaths. 10s here
+    # killed 50 healthy-but-starved raylets during the 1 GiB broadcast
+    # on the single-core CI box.
+    health_check_timeout_s: float = 30.0
+    # a raylet whose liveness thread beats but whose event loop reports
+    # lag beyond this is treated as dead (wedged loop = dead node; busy
+    # loop = alive). See raylet._start_liveness_thread.
+    loop_stall_death_s: float = 60.0
     # ---- control plane ----
     gcs_port: int = 0  # 0 = auto
     rpc_connect_timeout_s: float = 10.0
