@@ -1,0 +1,6 @@
+"""ray_tpu.models — flax model families with TPU-first layouts."""
+
+from ray_tpu.models.resnet import create_resnet  # noqa: F401
+from ray_tpu.models.gpt2 import GPT2Config  # noqa: F401
+from ray_tpu.models.llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, causal_lm_loss, import_hf_llama)

@@ -95,11 +95,14 @@ class HyperOptSearch(Searcher):
         by_label = {".".join(d.path): d for d in dims}
         flat = dict(consts)
         for label, v in vals.items():
-            dom = by_label[label].domain
+            dim = by_label[label]
+            dom = dim.domain
             if isinstance(dom, s.Categorical):
                 # hp.choice stores the chosen INDEX, not the value
                 v = dom.categories[int(v)]
-            flat[tuple(label.split("."))] = v
+            # key by the dimension's PATH, not label.split(".") — a
+            # space key containing a dot is one key, not a nest
+            flat[dim.path] = v
         return resolve(unflatten(flat), self._rng)
 
     def on_trial_complete(self, trial_id, result=None, error=False):
@@ -458,17 +461,23 @@ class FLAMLSearch(_AskTellSearch):
 
     def _setup(self):
         import flaml
+        from flaml import tune as ftune
+        # flaml consumes tune-style sample objects, same API shape as
+        # this framework's ray_tpu.tune.sample
         space = {}
         self._by_label = {}
         for d in self._ext_dims:
             label = ".".join(d.path)
             self._by_label[label] = d
             if d.kind == "cat":
-                space[label] = {"domain": list(d.categories)}
-            else:
+                space[label] = ftune.choice(list(d.categories))
+            elif d.log:
                 lo, hi = _num_bounds(d)
-                space[label] = {"domain": (lo, hi), "log": d.log,
-                                "int": d.integer}
+                space[label] = ftune.loguniform(lo, hi)
+            elif d.integer:
+                space[label] = ftune.randint(int(d.lo), int(d.hi) + 1)
+            else:
+                space[label] = ftune.uniform(d.lo, d.hi)
         cls = getattr(flaml, self._searcher_name)
         self._impl = cls(metric=self.metric,
                          mode="min",  # losses are sign-normalized here

@@ -139,6 +139,16 @@ def test_nevergrad_ask_tell_roundtrip(fake_nevergrad):
 @pytest.fixture
 def fake_flaml(monkeypatch):
     flaml = _FakeModule("flaml")
+    ftune = _FakeModule("flaml.tune")
+
+    class _Dom:
+        def __init__(self, kind, *args):
+            self.kind, self.args = kind, args
+
+    ftune.choice = lambda c: _Dom("choice", c)
+    ftune.loguniform = lambda lo, hi: _Dom("loguniform", lo, hi)
+    ftune.randint = lambda lo, hi: _Dom("randint", lo, hi)
+    ftune.uniform = lambda lo, hi: _Dom("uniform", lo, hi)
 
     class _Blend:
         def __init__(self, metric=None, mode=None, space=None):
@@ -152,7 +162,9 @@ def fake_flaml(monkeypatch):
             self.completed.append((tid, result, error))
 
     flaml.BlendSearch = _Blend
+    flaml.tune = ftune
     monkeypatch.setitem(sys.modules, "flaml", flaml)
+    monkeypatch.setitem(sys.modules, "flaml.tune", ftune)
     return flaml
 
 
@@ -163,9 +175,10 @@ def test_flaml_adapter(fake_flaml):
     searcher.on_trial_complete("t1", {"score": 2.0})
     tid, result, error = searcher._impl.completed[0]
     assert result == {"score": -2.0} and not error
-    # translated space carried log/int markers
-    assert searcher._impl.space["lr"]["log"] is True
-    assert searcher._impl.space["depth"]["int"] is True
+    # translated space used flaml.tune sample constructors
+    assert searcher._impl.space["lr"].kind == "loguniform"
+    assert searcher._impl.space["depth"].kind == "randint"
+    assert searcher._impl.space["act"].kind == "choice"
 
 
 def test_num_samples_exhausts(fake_skopt):
