@@ -1,11 +1,11 @@
-"""Fine-tune a Llama-family decoder with the SPMD trainer.
+"""Fine-tune a Llama-family decoder (single-host walkthrough).
 
-Walkthrough: build a (tiny) Llama with grouped-query attention, shard
-it over a dp×tp mesh, and run a few training steps through the same
-`DataParallelTrainer` path the ResNet/GPT-2 benches use.  Scale the
-config (`LlamaConfig.llama2_7b()`) and the mesh axes (fsdp/sp for long
-context) for real runs; weights import from a HF checkpoint via
-`import_hf_llama` when one is on disk.
+Builds a (tiny) Llama with grouped-query attention and runs a jitted
+train loop end-to-end — the minimal template for the model family.
+For the sharded multi-chip path, wrap the same model/loss in the SPMD
+trainer exactly as `examples/02_train_spmd.py` does for ResNet (mesh
+axes dp/fsdp/tp/sp via `parallel.mesh.MeshSpec`); weights import from
+a HF checkpoint via `import_hf_llama` when one is on disk.
 
 Run: python examples/06_llama_finetune.py
 """

@@ -1606,6 +1606,16 @@ class Raylet:
                 "sync_version": self._sync_version,
                 "known_view_version": self._known_view_version,
                 "cluster_view_nodes": len(self.cluster_view),
+                # dispatch core + liveness observables (round 4: the
+                # native schedcore ledger and the loop-lag that the
+                # liveness thread attests to the GCS). Lag values come
+                # from the OFF-LOOP liveness thread — a lag gauge
+                # computed in this on-loop handler could never observe
+                # a real stall (no responses during it; the tick timer
+                # re-stamps before stats run after it)
+                "sched_native": 1 if self.led.native else 0,
+                "event_loop_lag_s": getattr(self, "_lag_last", 0.0),
+                "event_loop_lag_peak_s": getattr(self, "_lag_peak", 0.0),
             },
             "object_store": {
                 **{k: int(v) for k, v in store.items()},
@@ -1879,6 +1889,10 @@ class Raylet:
                 conn = None
                 while not self._shutdown:
                     lag = time.monotonic() - self._loop_tick
+                    # off-loop lag observables for the stats agent
+                    self._lag_last = lag
+                    self._lag_peak = max(
+                        lag, getattr(self, "_lag_peak", 0.0))
                     try:
                         if conn is None or conn._closed:
                             conn = await protocol.connect(self.gcs_address)

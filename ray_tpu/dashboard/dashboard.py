@@ -254,7 +254,9 @@ def _node_gauges(state) -> str:
         sched = n.get("scheduler", {})
         for k in ("tasks_pending", "tasks_running",
                   "tasks_dispatched_total", "tasks_spilled_back_total",
-                  "workers_alive", "workers_idle", "actors_alive"):
+                  "workers_alive", "workers_idle", "actors_alive",
+                  "sched_native", "event_loop_lag_s",
+                  "event_loop_lag_peak_s"):
             g(f"scheduler_{k}", nid, sched.get(k, 0), f"scheduler {k}")
         for res, v in (sched.get("resources_available") or {}).items():
             if isinstance(v, (int, float)):

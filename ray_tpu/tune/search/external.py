@@ -53,7 +53,9 @@ class HyperOptSearch(Searcher):
         dims, consts = flatten_space(self._space)
         out = {}
         for d in dims:
-            label = ".".join(d.path)
+            # labels are repr(path): unambiguous even when a literal
+            # dotted key ("a.b") aliases a nested path ("a"->"b")
+            label = repr(d.path)
             dom = d.domain
             if isinstance(dom, s.Categorical):
                 out[label] = self._hp.choice(label, dom.categories)
@@ -92,7 +94,7 @@ class HyperOptSearch(Searcher):
         self._live[trial_id] = new_ids[0]
         from ray_tpu.tune import sample as s
         dims, _ = flatten_space(self._space)
-        by_label = {".".join(d.path): d for d in dims}
+        by_label = {repr(d.path): d for d in dims}
         flat = dict(consts)
         for label, v in vals.items():
             dim = by_label[label]
@@ -100,8 +102,8 @@ class HyperOptSearch(Searcher):
             if isinstance(dom, s.Categorical):
                 # hp.choice stores the chosen INDEX, not the value
                 v = dom.categories[int(v)]
-            # key by the dimension's PATH, not label.split(".") — a
-            # space key containing a dot is one key, not a nest
+            # key by the dimension's PATH, not a split of the label —
+            # a space key containing a dot is one key, not a nest
             flat[dim.path] = v
         return resolve(unflatten(flat), self._rng)
 

@@ -1,0 +1,142 @@
+"""XLA/TPU profiler integration — jax.profiler traces merged into the
+framework timeline by host.
+
+Reference analogue: SURVEY §5.1's TPU mapping of the reference's
+profile pipeline (core_worker/profiling.cc + dashboard
+reporter/profile_manager.py): keep the chrome-trace timeline, and merge
+per-worker `jax.profiler` captures (XLA's own device/compiler spans)
+into it so `ray-tpu timeline` shows framework task spans and the XLA
+ops they ran, host by host, on one time axis.
+
+Worker-side usage::
+
+    from ray_tpu.util import tpu_profiler
+    with tpu_profiler.trace():
+        state, metrics = train_step(state, batch)   # jitted work
+
+The capture lands in two places:
+  - the raw ``plugins/profile/<run>/`` artifacts (xplane.pb +
+    trace.json.gz) under the session dir, for TensorBoard's profile
+    plugin;
+  - the significant chrome events, rebased to wall-clock and re-tagged
+    with this host's identity, recorded into ``ray_tpu.util.timeline``
+    — the existing per-process → GCS KV → driver merge carries them
+    cross-host exactly like task spans.
+
+``serve(port)`` starts jax's live profiler server for on-demand
+TensorBoard attach (the analogue of the dashboard's on-demand py-spy).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import tempfile
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util import timeline
+
+# chrome pids are ints; XLA process rows get their own block so they
+# never collide with framework task pids (os.getpid()-based)
+_XLA_PID_BASE = 1 << 24
+
+
+def load_chrome_events(log_dir: str) -> List[Dict[str, Any]]:
+    """Chrome events from every ``*.trace.json.gz`` under a
+    jax.profiler log dir (one per host in multi-process captures)."""
+    events: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(
+            os.path.join(log_dir, "**", "*.trace.json.gz"),
+            recursive=True)):
+        with gzip.open(path, "rt") as f:
+            doc = json.load(f)
+        events.extend(doc.get("traceEvents", []))
+    return events
+
+
+def _significant(events: List[Dict[str, Any]], max_events: int,
+                 min_dur_us: float) -> List[Dict[str, Any]]:
+    """Complete ('X') spans above the duration floor, longest first,
+    capped — a raw XLA capture holds far more events than the timeline
+    ring buffer should absorb."""
+    spans = [e for e in events
+             if e.get("ph") == "X" and e.get("dur", 0) >= min_dur_us]
+    spans.sort(key=lambda e: -e.get("dur", 0))
+    return spans[:max_events]
+
+
+def merge_into_timeline(events: List[Dict[str, Any]], *,
+                        wall_start_us: float, label: str = "xla",
+                        max_events: int = 4000,
+                        min_dur_us: float = 5.0) -> int:
+    """Rebase a capture's events to wall-clock and record them into the
+    framework timeline under per-(host,xla-process) rows.  Returns the
+    number of events merged."""
+    spans = _significant(events, max_events, min_dur_us)
+    if not spans:
+        return 0
+    base = min(e["ts"] for e in spans)
+    node = os.environ.get("RTPU_NODE_ID", "")[:8] or "local"
+    seen_pids: Dict[int, int] = {}
+    for e in spans:
+        src_pid = int(e.get("pid", 0))
+        pid = seen_pids.get(src_pid)
+        if pid is None:
+            pid = _XLA_PID_BASE + (hash((node, src_pid)) & 0xFFFF)
+            seen_pids[src_pid] = pid
+            timeline.record(
+                "process_name", "M", 0, pid=pid,
+                args={"name": f"{label} {node} p{src_pid}"})
+        timeline.record(
+            e.get("name", "?"), "X",
+            wall_start_us + (e["ts"] - base),
+            pid=pid, tid=int(e.get("tid", 0)) % 1_000_000,
+            dur=e.get("dur", 0), cat=label,
+            args=e.get("args") or None)
+    timeline.flush()
+    return len(spans)
+
+
+def _capture_dir() -> str:
+    root = os.environ.get("RTPU_SESSION_DIR") or tempfile.gettempdir()
+    d = os.path.join(root, "xla_profiles")
+    os.makedirs(d, exist_ok=True)
+    return tempfile.mkdtemp(prefix="capture_", dir=d)
+
+
+@contextmanager
+def trace(label: str = "xla", *, log_dir: Optional[str] = None,
+          max_events: int = 4000, min_dur_us: float = 5.0,
+          keep_artifacts: bool = True):
+    """Capture a jax.profiler trace around the body and merge its
+    chrome events into the framework timeline (see module docstring)."""
+    import jax
+
+    d = log_dir or _capture_dir()
+    wall_start_us = time.time() * 1e6
+    jax.profiler.start_trace(d)
+    try:
+        yield d
+    finally:
+        jax.profiler.stop_trace()
+        try:
+            merge_into_timeline(
+                load_chrome_events(d), wall_start_us=wall_start_us,
+                label=label, max_events=max_events,
+                min_dur_us=min_dur_us)
+        except Exception:  # a merge failure must not fail the traced op
+            pass
+        if not keep_artifacts:
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def serve(port: int = 9012):
+    """Live profiler server for on-demand TensorBoard capture
+    (reference analogue: dashboard reporter's on-demand profiling)."""
+    import jax
+    return jax.profiler.start_server(port)
