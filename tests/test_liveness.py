@@ -1,0 +1,68 @@
+"""Liveness semantics: busy != dead, wedged == dead (the 1 GiB
+broadcast regression chain; see SCALE.md 'What the full-size broadcast
+re-run caught')."""
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu.common.config import SystemConfig
+
+
+class _Node:
+    def __init__(self):
+        self.alive = True
+        self.last_seen = 0.0
+
+
+@pytest.fixture
+def gcs():
+    g = GcsServer.__new__(GcsServer)
+    g.config = SystemConfig()
+    g.nodes = {"n1": _Node()}
+    return g
+
+
+def test_liveness_beat_refreshes_last_seen(gcs):
+    gcs.nodes["n1"].last_seen = 0.0
+    asyncio.run(gcs.node_liveness(
+        {"node_id": "n1", "loop_lag_s": 2.0}, None))
+    assert time.monotonic() - gcs.nodes["n1"].last_seen < 1.0
+
+
+def test_wedged_loop_does_not_count_as_alive(gcs):
+    """A beat carrying lag beyond loop_stall_death_s must NOT refresh
+    last_seen: the process is up but its event loop is dead."""
+    gcs.nodes["n1"].last_seen = 123.0
+    asyncio.run(gcs.node_liveness(
+        {"node_id": "n1",
+         "loop_lag_s": gcs.config.loop_stall_death_s + 1}, None))
+    assert gcs.nodes["n1"].last_seen == 123.0
+
+
+def test_beat_for_dead_node_does_not_resurrect(gcs):
+    """Death is sticky (reference: a dead raylet must restart, not
+    sneak back): a late beat from a node already marked dead must not
+    refresh its liveness."""
+    gcs.nodes["n1"].alive = False
+    gcs.nodes["n1"].last_seen = 123.0
+    asyncio.run(gcs.node_liveness(
+        {"node_id": "n1", "loop_lag_s": 0.1}, None))
+    assert gcs.nodes["n1"].last_seen == 123.0
+
+
+def test_beat_for_unknown_node_is_ignored(gcs):
+    asyncio.run(gcs.node_liveness(
+        {"node_id": "ghost", "loop_lag_s": 0.0}, None))  # no raise
+
+
+def test_death_window_default_tolerates_starved_hosts():
+    """The default death window must stay in the tens of seconds — the
+    reference declares death only after a probe-failure STREAK, and a
+    10s window killed 50 starved-but-healthy raylets in the full-size
+    broadcast."""
+    cfg = SystemConfig()
+    assert cfg.health_check_timeout_s >= 30.0
+    assert cfg.loop_stall_death_s > cfg.health_check_timeout_s
