@@ -1,0 +1,146 @@
+"""Differential + invariant fuzz for the scheduling ledgers.
+
+Drives PyLedger and NativeLedger through identical randomized op
+sequences (submit / poll / release / bundle prepare-commit-cancel-
+return / drain) and asserts, at every quiescent point:
+
+  - CONSERVATION: after all queues drain and everything releases, the
+    node pool returns to exactly the initial totals and chip set;
+  - COMPLETION: every submitted task either dispatches or is doomed
+    with its placement group — nothing is stranded;
+  - SAFETY: availability never goes negative, never exceeds totals,
+    chips are never double-granted, grants are never partial.
+
+(Cross-ledger SCHEDULES are deliberately not compared: which class
+wins contended resources at each poll is unspecified — see the
+sched.py docstring — so two valid ledgers produce different dispatch
+multisets for the same interleaved op sequence.)
+
+This is the permanent form of the ad-hoc differential fuzzer used to
+verify the schedcore port during review.
+"""
+
+import random
+
+import pytest
+
+from ray_tpu._private.sched import (NativeLedger, PendingTask, PyLedger,
+                                    _lib)
+
+TOTALS = {"CPU": 8.0, "TPU": 4.0, "memory": 1e9}
+CHIPS = [0, 1, 2, 3]
+
+DEMANDS = [
+    {"CPU": 1.0},
+    {"CPU": 0.5},
+    {"CPU": 2.0, "TPU": 1},
+    {"CPU": 1.0, "TPU": 2},
+    {"CPU": 1.0 / 3.0},
+    {"CPU": 0.5, "memory": 1e8},
+]
+
+
+def _pt(demand, pg=None):
+    spec = {"resources": dict(demand), "task_id": "t"}
+    if pg:
+        spec["placement_group"] = pg
+    return PendingTask(spec, None)
+
+
+def _chips_outstanding(granted):
+    return sorted(c for chips in granted.values() for c in chips)
+
+
+def _drive(led, seed, steps=400):
+    """One randomized session; returns the multiset of dispatched
+    demands. Asserts safety invariants throughout."""
+    rng = random.Random(seed)
+    running = {}          # id(pt) -> (pt, chips)
+    bundles = {}          # key -> state in {"prepared", "committed"}
+    dispatched = []
+    next_pg = 0
+
+    for _ in range(steps):
+        op = rng.random()
+        if op < 0.35:  # submit a plain or bundle task
+            if bundles and rng.random() < 0.4:
+                key = rng.choice(list(bundles))
+                pt = _pt(rng.choice(DEMANDS[:2]),
+                         pg={"pg_id": key[0], "bundle_index": key[1]})
+            else:
+                pt = _pt(rng.choice(DEMANDS))
+            led.append(pt)
+        elif op < 0.60:  # poll + start whatever dispatches
+            dispatches, blocked, more = led.poll()
+            for pt, chips in dispatches:
+                assert len(chips) == pt.tpu_demand  # full grant only
+                running[id(pt)] = (pt, chips)
+                dispatched.append(tuple(sorted(pt.demand.items())))
+            out = _chips_outstanding(
+                {k: v[1] for k, v in running.items()})
+            assert len(out) == len(set(out)), "chip double-grant"
+        elif op < 0.80 and running:  # finish a running task
+            k = rng.choice(list(running))
+            pt, chips = running.pop(k)
+            led.release(pt, chips)
+        elif op < 0.86:  # new bundle prepare
+            key = (f"pg{next_pg}", 0)
+            next_pg += 1
+            if led.prepare_bundle(key, rng.choice(
+                    [{"CPU": 1.0}, {"CPU": 2.0, "TPU": 1}])):
+                bundles[key] = "prepared"
+        elif op < 0.92 and bundles:  # advance a bundle's lifecycle
+            key = rng.choice(list(bundles))
+            if bundles[key] == "prepared":
+                if rng.random() < 0.5:
+                    assert led.commit_bundle(key)
+                    bundles[key] = "committed"
+                else:
+                    led.cancel_bundle(key)
+                    led.drain_pg(key[0])  # doom queued targeters
+                    del bundles[key]
+            else:
+                led.return_bundle(key)
+                for pt in led.drain_pg(key[0]):
+                    pass  # doomed while queued: nothing was granted
+                del bundles[key]
+        # availability must never exceed totals or go negative
+        for name, total in TOTALS.items():
+            avail = led.avail_get(name)
+            assert -1e-6 <= avail <= total + 1e-6, (name, avail)
+
+    # quiesce: finish running tasks, return bundles, drain queues
+    for pt, chips in list(running.values()):
+        led.release(pt, chips)
+    for key, state in list(bundles.items()):
+        if state == "prepared":
+            led.cancel_bundle(key)
+        else:
+            led.return_bundle(key)
+        led.drain_pg(key[0])
+    # with all bundles gone and resources free, every remaining queued
+    # task is plain and must dispatch — nothing may be stranded
+    while True:
+        dispatches, blocked, more = led.poll()
+        if not dispatches:
+            break
+        for pt, chips in dispatches:
+            led.release(pt, chips)
+            dispatched.append(tuple(sorted(pt.demand.items())))
+    assert led.pending_tasks() == [], "stranded tasks after quiesce"
+    return dispatched
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_conservation_completion_safety(seed):
+    if _lib() is None:
+        pytest.skip("native lib unavailable")
+    for cls in (PyLedger, NativeLedger):
+        led = cls(dict(TOTALS), list(CHIPS))
+        _drive(led, seed)
+        # conservation: the node pool is exactly restored
+        for name, total in TOTALS.items():
+            assert led.avail_get(name) == pytest.approx(total, abs=1e-3), \
+                (cls.__name__, name, led.avail_get(name))
+        assert led.node_chips_count() == len(CHIPS), cls.__name__
+        assert led.pending_count() == 0, cls.__name__
