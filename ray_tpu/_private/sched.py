@@ -27,6 +27,7 @@ resources into node-local instances).
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import tempfile
 import time
@@ -46,6 +47,8 @@ POLL_MAXBLOCKED = 512
 
 _u64p = ctypes.POINTER(ctypes.c_uint64)
 _i32p = ctypes.POINTER(ctypes.c_int32)
+
+logger = logging.getLogger(__name__)
 _f64p = ctypes.POINTER(ctypes.c_double)
 
 
@@ -575,9 +578,18 @@ class NativeLedger:
         tags = self._tags
         for i in range(n):
             pt = tags.pop(self._b_tags[i], None)
-            if pt is None:  # should not happen; drop the acquire on floor
-                continue
             off, cnt = self._b_off[i], self._b_cnt[i]
+            if pt is None:
+                # Tag-map desync: the C++ ledger already deducted resources
+                # and chips for this head.  Refund the orphaned acquire so
+                # capacity is not leaked, and log the desync.
+                chips = (ctypes.c_int32 * cnt)(*self._b_chips[off:off + cnt]) \
+                    if cnt else ctypes.cast(None, _i32p)
+                lib.scx_release(self._h, self._b_cls[i], chips, cnt)
+                logger.warning("NativeLedger.poll: unknown tag %r from "
+                               "scx_poll; refunded class %d (%d chips)",
+                               self._b_tags[i], self._b_cls[i], cnt)
+                continue
             dispatches.append((pt, tuple(self._b_chips[off:off + cnt])))
         blocked = []
         for i in range(nblocked.value):

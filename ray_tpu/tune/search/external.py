@@ -347,6 +347,9 @@ class SkOptSearch(_AskTellSearch):
                     name=label))
         self._impl = skopt.Optimizer(
             sk_dims, random_state=self._seed, **self._lib_kwargs)
+        self._worst_loss = None
+        self._best_loss = None
+        self._pending_errors = []
 
     def _ask(self):
         x = self._impl.ask()
@@ -354,8 +357,31 @@ class SkOptSearch(_AskTellSearch):
 
     def _tell(self, handle, loss, error):
         if error:
-            return  # skopt has no failure state; drop the point
+            # skopt has no failure state; tell it a penalized objective so
+            # the optimizer learns the region is bad instead of re-suggesting
+            # configurations near the failing point.  Until a real loss has
+            # been observed there is no scale to penalize against — park the
+            # handle and flush it after the first success.
+            if self._worst_loss is None:
+                self._pending_errors.append(handle)
+            else:
+                self._impl.tell(handle, self._penalty())
+            return
+        self._worst_loss = loss if self._worst_loss is None \
+            else max(self._worst_loss, loss)
+        self._best_loss = loss if self._best_loss is None \
+            else min(self._best_loss, loss)
         self._impl.tell(handle, loss)
+        while self._pending_errors:
+            self._impl.tell(self._pending_errors.pop(), self._penalty())
+
+    def _penalty(self):
+        # Strictly worse than everything observed, by the observed range
+        # (or a fixed margin when the range is degenerate), so a failed
+        # config never looks comparatively good as new results arrive.
+        span = self._worst_loss - self._best_loss
+        margin = span if span > 0 else abs(self._worst_loss) * 0.1 + 1.0
+        return self._worst_loss + margin
 
 
 class NevergradSearch(_AskTellSearch):
@@ -389,7 +415,10 @@ class NevergradSearch(_AskTellSearch):
             else:
                 params[label] = ng.p.Scalar(lower=d.lo, upper=d.hi)
         opt_cls = ng.optimizers.registry[self._optimizer_name]
-        self._impl = opt_cls(parametrization=ng.p.Dict(**params),
+        parametrization = ng.p.Dict(**params)
+        if self._seed is not None:
+            parametrization.random_state.seed(self._seed)
+        self._impl = opt_cls(parametrization=parametrization,
                              budget=self._budget)
 
     def _ask(self):

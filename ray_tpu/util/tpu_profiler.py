@@ -35,6 +35,7 @@ import json
 import os
 import tempfile
 import time
+import zlib
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -86,7 +87,11 @@ def merge_into_timeline(events: List[Dict[str, Any]], *,
         src_pid = int(e.get("pid", 0))
         pid = seen_pids.get(src_pid)
         if pid is None:
-            pid = _XLA_PID_BASE + (hash((node, src_pid)) & 0xFFFF)
+            # Deterministic digest (not Python's randomized hash()) so XLA
+            # process rows are stable across restarts and don't collide
+            # between hosts within the 16-bit space.
+            digest = zlib.crc32(f"{node}:{src_pid}".encode())
+            pid = _XLA_PID_BASE + (digest & 0xFFFF)
             seen_pids[src_pid] = pid
             timeline.record(
                 "process_name", "M", 0, pid=pid,

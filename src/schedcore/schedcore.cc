@@ -389,12 +389,18 @@ int scx_poll(void* h, uint64_t* tags, int32_t* clss, int32_t* chip_off,
     Class& k = c->classes[(size_t)ci];
     if (k.q.empty()) { k.active = false; continue; }  // compact out
     c->active[w++] = ci;
+    // Rotating report window: blocked classes (either oversized or
+    // currently-infeasible heads) share the maxblocked report slots
+    // across polls so none can starve the others.
+    const bool in_window =
+        (j >= rot && (long long)(j - rot) < (long long)maxblocked) ||
+        (j < rot && (long long)(nact - rot + j) < (long long)maxblocked);
     while (!k.q.empty()) {
       if (k.tpu > maxchips) {
         // can NEVER fit the chip buffer: report blocked (the caller's
         // spillback policy handles it) — `more` would busy-spin
         blocked_total++;
-        if (nb < maxblocked) {
+        if (in_window && nb < maxblocked) {
           blocked_tags[nb] = k.q.front();
           blocked_cls[nb] = ci;
           nb++;
@@ -406,10 +412,6 @@ int scx_poll(void* h, uint64_t* tags, int32_t* clss, int32_t* chip_off,
       if (got < 0) {
         // blocked head: report for spillback policy, rotated window
         blocked_total++;
-        bool in_window =
-            (j >= rot && (long long)(j - rot) < (long long)maxblocked) ||
-            (j < rot &&
-             (long long)(nact - rot + j) < (long long)maxblocked);
         if (in_window && nb < maxblocked) {
           blocked_tags[nb] = k.q.front();
           blocked_cls[nb] = ci;

@@ -76,14 +76,32 @@ def test_skopt_ask_tell_roundtrip(fake_skopt):
     searcher.on_trial_complete("t1", {"score": 0.9})
     impl = searcher._impl
     assert impl.told == [([0.01, 2, "relu"], -0.9)]  # max -> minimize flip
-    # error completions are dropped, not told
+    # error completions are told a penalized objective (strictly worse
+    # than everything observed) so the optimizer learns the region is bad
     searcher.suggest("t2")
     searcher.on_trial_complete("t2", error=True)
-    assert len(impl.told) == 1
+    assert len(impl.told) == 2
+    assert impl.told[1][0] == [0.02, 2, "relu"]
+    assert impl.told[1][1] > -0.9  # worse than the only real loss
     # categorical dims got the category list
     cats = [d for d in impl.dims if d.args and
             isinstance(d.args[0], list) and "relu" in d.args[0]]
     assert cats
+
+
+def test_skopt_error_before_any_success_is_parked(fake_skopt):
+    # an error with no prior success is parked (no loss scale yet), then
+    # flushed after the first real completion with a penalty worse than it
+    searcher = SkOptSearch(space=SPACE, metric="score", mode="max", seed=0)
+    impl_told = lambda: searcher._impl.told
+    searcher.suggest("t1")
+    searcher.suggest("t2")
+    searcher.on_trial_complete("t1", error=True)
+    assert impl_told() == []  # parked, nothing told yet
+    searcher.on_trial_complete("t2", {"score": 0.5})
+    assert len(impl_told()) == 2  # real loss + flushed penalty
+    assert impl_told()[0][1] == pytest.approx(-0.5)
+    assert impl_told()[1][1] > -0.5
 
 
 @pytest.fixture
