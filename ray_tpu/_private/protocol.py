@@ -154,6 +154,26 @@ class Connection:
             self.writer.close()
         except Exception:
             pass
+        # Cancel the read loop so the task isn't abandoned pending — an
+        # un-cancelled _read_loop is GC'd later as "Task was destroyed
+        # but it is pending!", masking real errors in every log.
+        task = self._task
+        if task is not None and not task.done():
+            loop = task.get_loop()
+            if loop.is_running():
+                loop.call_soon_threadsafe(task.cancel)
+            else:
+                task.cancel()
+
+    async def aclose(self):
+        """Close and wait for the read loop to finish unwinding."""
+        self.close()
+        task = self._task
+        if task is not None:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
 
 
 class Server:
@@ -210,6 +230,53 @@ class Server:
             self._server.close()
         for c in list(self.connections):
             c.close()
+
+
+async def single_flight_connect(cache: Dict[str, "Connection"],
+                                pending: Dict[str, "asyncio.Future"],
+                                address: str,
+                                dial: Callable[[str], Awaitable["Connection"]]
+                                ) -> "Connection":
+    """Cached, single-flight dialing: concurrent callers of the same
+    address share one in-flight dial instead of racing N parallel
+    connects where every Connection but the last-stored leaks an open
+    read loop (GC'd later as "Task was destroyed but it is pending!").
+
+    Must be called from the loop that owns `cache`/`pending`.  A failed
+    leader dial wakes the waiters, and one of them retries as leader;
+    a caller's own cancellation propagates (it is never confused with
+    the leader's failure — leader cancellation is translated to
+    ConnectionError on the shared future)."""
+    while True:
+        conn = cache.get(address)
+        if conn is not None and not conn._closed:
+            return conn
+        fut = pending.get(address)
+        if fut is not None:
+            try:
+                return await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                raise  # our own cancellation — the shared fut is never
+                # cancelled and leader cancellation arrives as
+                # ConnectionError below
+            except Exception:
+                continue  # leader's dial failed — retry as leader
+        fut = asyncio.get_running_loop().create_future()
+        pending[address] = fut
+        try:
+            conn = await dial(address)
+        except BaseException as e:
+            pending.pop(address, None)
+            if isinstance(e, asyncio.CancelledError):
+                fut.set_exception(ConnectionError("dial cancelled"))
+            else:
+                fut.set_exception(e)
+            fut.exception()  # consumed here: waiters retry via the loop
+            raise
+        cache[address] = conn
+        pending.pop(address, None)
+        fut.set_result(conn)
+        return conn
 
 
 async def connect(address: str,
@@ -331,6 +398,7 @@ class EventLoopThread:
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._started = threading.Event()
+        self._stop_called = False
         self._inflight: set = set()  # strong refs to fire-and-forget tasks
         # stall detector (reference: the asio event-loop instrumentation
         # in common/asio/ + the debug loop-lag monitors): a heartbeat
@@ -413,5 +481,33 @@ class EventLoopThread:
         return fut
 
     def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        """Drain-and-stop: cancel every pending task on the loop, await
+        the unwinds, then stop and close the loop.  Skipping the drain
+        leaves tasks to be GC'd pending ("Task was destroyed!") and
+        callbacks to fire on a closed loop ("Event loop is closed").
+
+        Idempotent: a second call must not schedule a drain onto a loop
+        that already stopped (the coroutine would never be awaited) —
+        it only finishes the close if the first call's join timed out."""
+        if self._stop_called:
+            if not self._thread.is_alive() and not self.loop.is_closed():
+                self.loop.close()
+            return
+        self._stop_called = True
+
+        async def _drain():
+            me = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not me]
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+            self.loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_drain(), self.loop)
+        except RuntimeError:
+            return  # loop already stopped/closed
         self._thread.join(timeout=5)
+        if not self._thread.is_alive() and not self.loop.is_closed():
+            self.loop.close()

@@ -301,6 +301,7 @@ class Raylet:
         self.idle_workers: Dict[str, List[WorkerHandle]] = {}  # keyed by env hash
         self._spilling_classes: set = set()
         self._peer_raylets: Dict[str, Any] = {}
+        self._peer_raylet_pending: Dict[str, Any] = {}
         self.gcs: Optional[protocol.Connection] = None
         self.server = protocol.Server(self._handlers())
         self.address = ""
@@ -808,13 +809,12 @@ class Raylet:
 
     async def _raylet_peer(self, address: str) -> "protocol.Connection":
         """Cached connection to a peer raylet (spillback reuses it; a
-        fresh dial per spilled task would dominate a backlog drain)."""
-        conn = self._peer_raylets.get(address)
-        if conn is not None and not conn._closed:
-            return conn
-        conn = await protocol.connect(address)
-        self._peer_raylets[address] = conn
-        return conn
+        fresh dial per spilled task would dominate a backlog drain).
+        Single-flight per address: concurrent spillback probes must not
+        race N dials where all but the last-stored leak open."""
+        return await protocol.single_flight_connect(
+            self._peer_raylets, self._peer_raylet_pending, address,
+            protocol.connect)
 
     async def _dispatch_loop(self):
         """The hot dispatch loop (reference:

@@ -390,8 +390,9 @@ class Worker:
         self._submit_buf: List[Tuple[Dict[str, Any], PendingTaskState]] = []
         self._submit_lock = threading.Lock()
         self._submit_flush_scheduled = False
+        # io-loop only; see protocol.single_flight_connect
         self._peer_conns: Dict[str, protocol.Connection] = {}
-        self._peer_lock = threading.Lock()
+        self._peer_pending: Dict[str, "asyncio.Future"] = {}
         self.session_dir = ""
         self.namespace = ""
         self.runtime_context: Dict[str, Any] = {}
@@ -586,14 +587,9 @@ class Worker:
         return await fn(payload, conn)
 
     async def _peer(self, address: str) -> protocol.Connection:
-        with self._peer_lock:
-            conn = self._peer_conns.get(address)
-        if conn is not None and not conn._closed:
-            return conn
-        conn = await protocol.connect(address, handler=self._handle_request)
-        with self._peer_lock:
-            self._peer_conns[address] = conn
-        return conn
+        return await protocol.single_flight_connect(
+            self._peer_conns, self._peer_pending, address,
+            lambda a: protocol.connect(a, handler=self._handle_request))
 
     def prepare_runtime_env(self, runtime_env):
         """Upload local working_dir/py_modules to GCS KV, rewriting the env

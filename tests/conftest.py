@@ -39,6 +39,39 @@ except Exception:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _no_asyncio_teardown_leaks():
+    """Regression gate for shutdown hygiene: a Connection/EventLoopThread
+    that abandons pending tasks surfaces here as "Task was destroyed but
+    it is pending!" (Task.__del__ -> asyncio logger) or "Event loop is
+    closed" callbacks.  Zero tolerance — these mask real errors in every
+    long-lived process log."""
+    import gc
+    import logging
+
+    leaked = []
+
+    class _Trap(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            # "Event loop is closed" rides in exc_info (the default
+            # asyncio exception handler logs "Exception in callback ..."
+            # with the RuntimeError attached), not the message text.
+            if record.exc_info and record.exc_info[1] is not None:
+                msg += f" | {record.exc_info[1]!r}"
+            if ("Task was destroyed but it is pending" in msg
+                    or "Event loop is closed" in msg):
+                leaked.append(msg)
+
+    trap = _Trap()
+    logging.getLogger("asyncio").addHandler(trap)
+    yield
+    gc.collect()  # force pending Task.__del__ before we assert
+    logging.getLogger("asyncio").removeHandler(trap)
+    assert not leaked, (
+        f"{len(leaked)} asyncio teardown leak(s); first 5: {leaked[:5]}")
+
+
 @pytest.fixture(scope="function")
 def ray_start_regular():
     import ray_tpu

@@ -1,0 +1,146 @@
+"""Shutdown hygiene of the RPC plane (reference: the reference's
+core_worker/raylet destructors join their io_service threads —
+src/ray/common/asio/ — so no pending handler outlives its loop).
+
+These are the regression tests for the round-4 verdict item "every
+long-lived process sprays 'Task was destroyed but it is pending!' on
+shutdown": Connection.close() must cancel its read loop, aclose() must
+wait for the unwind, EventLoopThread.stop() must drain every pending
+task before closing the loop, and single-flight dialing must never
+leak a raced Connection.
+"""
+
+import asyncio
+import gc
+
+import pytest
+
+from ray_tpu._private import protocol
+
+
+async def _echo_handler(method, payload, conn):
+    return payload
+
+
+@pytest.fixture
+def io():
+    t = protocol.EventLoopThread(name="test-io")
+    yield t
+    t.stop()
+
+
+def test_connection_close_cancels_read_loop(io):
+    async def scenario():
+        server = protocol.Server({"echo": lambda p, c: _echo_handler(
+            "echo", p, c)})
+        port = await server.start_tcp("127.0.0.1", 0)
+        conn = await protocol.connect(f"127.0.0.1:{port}")
+        assert await conn.call("echo", {"x": 1}) == {"x": 1}
+        task = conn._task
+        assert not task.done()
+        await conn.aclose()
+        assert task.done()
+        server.close()
+        return True
+
+    assert io.run(scenario())
+
+
+def test_event_loop_thread_stop_drains_pending_tasks():
+    t = protocol.EventLoopThread(name="drain-io")
+
+    async def hang_forever():
+        await asyncio.Event().wait()
+
+    futs = [t.run_async(hang_forever()) for _ in range(5)]
+    t.stop()
+    assert t.loop.is_closed()
+    for f in futs:
+        assert f.done()  # cancelled by the drain, not abandoned
+    # a second stop is a no-op, not a drain scheduled onto a dead loop
+    t.stop()
+    gc.collect()  # would emit "Task was destroyed" if the drain missed any
+
+
+def test_single_flight_connect_dedups_racing_dials(io):
+    async def scenario():
+        server = protocol.Server({"echo": lambda p, c: _echo_handler(
+            "echo", p, c)})
+        port = await server.start_tcp("127.0.0.1", 0)
+        cache, pending, dials = {}, {}, []
+
+        async def dial(addr):
+            dials.append(addr)
+            await asyncio.sleep(0.01)  # hold the dial open so callers pile up
+            return await protocol.connect(addr)
+
+        conns = await asyncio.gather(*[
+            protocol.single_flight_connect(
+                cache, pending, f"127.0.0.1:{port}", dial)
+            for _ in range(20)])
+        assert len(dials) == 1  # one leader, 19 waiters
+        assert all(c is conns[0] for c in conns)
+        assert not pending
+        await conns[0].aclose()
+        server.close()
+        return True
+
+    assert io.run(scenario())
+
+
+def test_single_flight_failed_leader_lets_waiter_retry(io):
+    async def scenario():
+        cache, pending = {}, {}
+        attempts = []
+
+        async def dial(addr):
+            attempts.append(addr)
+            if len(attempts) == 1:
+                await asyncio.sleep(0.01)
+                raise ConnectionError("first dial refused")
+            server = protocol.Server({})
+            port = await server.start_tcp("127.0.0.1", 0)
+            return await protocol.connect(f"127.0.0.1:{port}")
+
+        results = await asyncio.gather(*[
+            protocol.single_flight_connect(cache, pending, "fake:1", dial)
+            for _ in range(4)], return_exceptions=True)
+        # the leader saw its own ConnectionError; a waiter retried as
+        # leader and the rest shared its successful dial
+        errs = [r for r in results if isinstance(r, Exception)]
+        conns = [r for r in results if isinstance(r, protocol.Connection)]
+        assert len(errs) == 1 and isinstance(errs[0], ConnectionError)
+        assert len(conns) == 3 and all(c is conns[0] for c in conns)
+        assert len(attempts) == 2
+        await conns[0].aclose()
+        return True
+
+    assert io.run(scenario())
+
+
+def test_single_flight_waiter_cancellation_propagates(io):
+    async def scenario():
+        cache, pending = {}, {}
+        started = asyncio.Event()
+
+        async def dial(addr):
+            started.set()
+            await asyncio.sleep(5)
+            raise AssertionError("dial should have been abandoned")
+
+        leader = asyncio.ensure_future(
+            protocol.single_flight_connect(cache, pending, "fake:2", dial))
+        await started.wait()
+        waiter = asyncio.ensure_future(
+            protocol.single_flight_connect(cache, pending, "fake:2", dial))
+        await asyncio.sleep(0.01)
+        waiter.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        leader.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await leader
+        assert not pending  # leader unwound its single-flight slot
+        return True
+
+    assert io.run(scenario())
