@@ -172,7 +172,11 @@ class Connection:
         if task is not None:
             try:
                 await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
+                cur = asyncio.current_task()
+                if cur is not None and cur.cancelling():
+                    raise  # OUR cancellation, not the read loop's
+            except Exception:  # noqa: BLE001 — read-loop teardown errors
                 pass
 
 

@@ -132,6 +132,47 @@ def test_flash_attention_grads_match_reference():
                                    atol=5e-5, rtol=5e-5)
 
 
+def test_flash_attention_whole_vs_streaming_paths(monkeypatch):
+    """The short-sequence whole-kv kernels and the streaming flash
+    kernels must agree with each other and the reference — fwd and
+    grads (RTPU_ATTN_EXACT=1 forces the streaming path)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops import attention as A
+
+    rng = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (2, 2, 256, 64)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    assert A._use_whole_kv(256, 256, 64)
+
+    def loss(fn):
+        return lambda q, k, v: fn(q, k, v).sum()
+
+    def flash(q, k, v):
+        return A.flash_attention(q, k, v, causal=True, force_pallas=True,
+                                 interpret=True, block_q=128, block_k=128)
+
+    ref = A.attention_reference(q, k, v, causal=True)
+    out_whole = flash(q, k, v)
+    g_whole = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("RTPU_ATTN_EXACT", "1")
+    assert not A._use_whole_kv(256, 256, 64)
+    out_stream = flash(q, k, v)
+    g_stream = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.delenv("RTPU_ATTN_EXACT")
+
+    np.testing.assert_allclose(np.asarray(out_whole), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_stream), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    for a, b in zip(g_whole, g_stream):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
 def test_ring_attention_matches_full(cpu_mesh8):
     import jax
     import jax.numpy as jnp
