@@ -258,8 +258,12 @@ def cancel(ref: ObjectRef, *, force: bool = False):
         c.cancel(ref.hex(), force=force)
         return
     w = _worker_mod.global_worker()
+    task_id = ref.id().task_id().hex()
+    # leased/parked tasks are invisible to the raylet (direct
+    # owner->worker pushes) — cancel them owner-side first
+    w.cancel_leased_task(task_id)
     w.call_sync(w.raylet, "cancel_task",
-                {"task_id": ref.id().task_id().hex(), "force": force})
+                {"task_id": task_id, "force": force})
 
 
 def cluster_resources() -> Dict[str, float]:

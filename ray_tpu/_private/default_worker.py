@@ -12,6 +12,15 @@ def main():
     # SIGUSR1 dumps all thread stacks to stderr (worker .err log) — the
     # hung-worker debugging hook (reference: ray SIGTERM stack traces).
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    if os.environ.get("RTPU_CPROFILE_DIR") and \
+            "worker" in os.environ.get("RTPU_CPROFILE_PROCS", "worker"):
+        import atexit
+        import cProfile
+        profiler = cProfile.Profile()
+        profiler.enable()
+        atexit.register(lambda: profiler.dump_stats(os.path.join(
+            os.environ["RTPU_CPROFILE_DIR"],
+            f"worker_{os.getpid()}.pstats")))
     from ray_tpu._private.worker import Worker, MODE_WORKER
 
     w = Worker()

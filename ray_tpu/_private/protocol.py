@@ -427,10 +427,28 @@ class EventLoopThread:
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
+        import os as _os
+        prof_dir = _os.environ.get("RTPU_CPROFILE_DIR")
+        prof = None
+        if prof_dir and "loop" not in _os.environ.get(
+                "RTPU_CPROFILE_PROCS", "loop"):
+            prof_dir = None
+        if prof_dir:
+            # perf-debug aid: profile THIS loop thread (cProfile is
+            # per-thread; the main-thread profilers can't see handler
+            # work running here)
+            import cProfile
+            prof = cProfile.Profile()
+            prof.enable()
         self.loop.call_soon(self._started.set)
         if self._stall_s > 0:
             self._start_stall_detector()
         self.loop.run_forever()
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(_os.path.join(
+                prof_dir,
+                f"loop_{_os.getpid()}_{self._thread.name}.pstats"))
 
     def _start_stall_detector(self):
         import sys
@@ -472,6 +490,12 @@ class EventLoopThread:
         """Run coroutine on the IO loop, block until done, return result."""
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
+
+    def call_soon(self, fn, *args):
+        """Schedule a plain callback on the loop from any thread.  Much
+        lighter than run_coroutine_threadsafe (~no Future chaining) —
+        the submit hot path uses this to wake the flusher."""
+        self.loop.call_soon_threadsafe(fn, *args)
 
     def run_async(self, coro):
         """Fire-and-forget — but with a STRONG reference held until

@@ -183,6 +183,7 @@ class WorkerHandle:
         self.conn: Optional[protocol.Connection] = None
         self.address: str = ""
         self.busy_task: Optional[str] = None
+        self.leased_by: Optional[str] = None
         self.is_actor = False
         self.actor_id: Optional[str] = None
         self.idle_since = time.monotonic()
@@ -302,6 +303,15 @@ class Raylet:
         self._spilling_classes: set = set()
         self._peer_raylets: Dict[str, Any] = {}
         self._peer_raylet_pending: Dict[str, Any] = {}
+        # coalesced task_dispatch_status notifies (conn-id -> (conn, [..]))
+        self._dispatch_status_buf: Dict[int, Any] = {}
+        self._dispatch_status_flush_scheduled = False
+        # worker leases: owner-held workers for direct task pushes
+        # (reference: normal_task_submitter.cc lease-based dispatch)
+        self._leases: Dict[str, Any] = {}
+        self._lease_counter = 0
+        self._last_lease_revoke = 0.0
+        self._lease_owner_conns: Dict[str, Any] = {}
         self.gcs: Optional[protocol.Connection] = None
         self.server = protocol.Server(self._handlers())
         self.address = ""
@@ -341,6 +351,8 @@ class Raylet:
             "node_stats": self.handle_node_stats,
             "dump_worker_stacks": self.handle_dump_worker_stacks,
             "cancel_task": self.handle_cancel_task,
+            "lease_worker": self.handle_lease_worker,
+            "release_lease": self.handle_release_lease,
             "_on_disconnect": self._on_disconnect,
         }
 
@@ -413,6 +425,8 @@ class Raylet:
         return await fn(payload, conn)
 
     async def _on_disconnect(self, conn):
+        for lease_id in conn.meta.get("leases", ()):
+            self._release_lease(lease_id)  # owner died holding leases
         wid = conn.meta.get("worker_id")
         if wid:
             await self._handle_worker_death(wid, "connection lost")
@@ -586,6 +600,7 @@ class Raylet:
         ev.report(severity, label, message, gcs_notify=_notify, **fields)
 
     async def _handle_worker_death(self, worker_id: str, reason: str):
+        self._clean_leases_for_worker(worker_id)
         handle = self.workers.pop(worker_id, None)
         if handle is None:
             return
@@ -732,17 +747,15 @@ class Raylet:
                     reply = f.result()
                 except Exception as e:  # noqa: BLE001 — crosses the wire
                     reply = {"error": "INTERNAL", "message": str(e)}
-
-                # every dispatch outcome is notified — success carries
-                # worker_address so the owner can tell "dispatched"
-                # from "still queued" when this connection dies
-                async def _notify():
-                    try:
-                        await conn.notify("task_dispatch_status",
-                                          {"task_id": task_id, **reply})
-                    except Exception:
-                        pass  # owner-side on_close handles a dead conn
-                protocol.spawn(_notify())
+                # every dispatch outcome is reported — success carries
+                # worker_address so the owner can tell "dispatched" from
+                # "still queued" when this connection dies.  Failures go
+                # out immediately; successes coalesce into one batched
+                # notify per flush tick (they are bookkeeping, not the
+                # result fast path — the worker sends results directly),
+                # which halves the raylet's per-task sends.
+                self._queue_dispatch_status(conn, {"task_id": task_id,
+                                                   **reply})
 
             fut.add_done_callback(_on_done)
             if self._infeasible(ptask) or spec.get("spilled_from") or \
@@ -835,6 +848,20 @@ class Raylet:
             dispatches, blocked, more = self.led.poll()
             for ptask, chips in dispatches:
                 protocol.spawn(self._dispatch(ptask, chips))
+            if blocked and self._leases and \
+                    now - self._last_lease_revoke > 0.5 and \
+                    any(pt.tpu_demand == 0
+                        and pt.demand.get("CPU", 0) > 0
+                        for pt in blocked):
+                # leased capacity is starving queued CPU work: revoke
+                # one lease (the owner drains in-flight pushes and falls
+                # back to the normal path) — reference: lease revocation
+                # under contention in local_task_manager.  Chip-bound
+                # backlogs (TPU demands) don't revoke: CPU leases can't
+                # unblock them and churning the pool helps nothing.
+                self._last_lease_revoke = now
+                lease_id = next(iter(self._leases))
+                protocol.spawn(self._revoke_lease(lease_id))
             for ptask in blocked:
                 # try spillback for plain tasks stuck too long
                 cls = ptask.sched_class
@@ -939,6 +966,131 @@ class Raylet:
                 "worker_id": handle.worker_id,
                 "worker_address": handle.address,
             })
+
+    # ------------------------------------------------------- worker leases
+
+    async def handle_lease_worker(self, payload, conn):
+        """Grant the caller a pinned worker for DIRECT owner->worker task
+        pushes — the reference's lease-based dispatch
+        (reference: src/ray/core_worker/transport/normal_task_submitter.cc):
+        the lease holds the demand's resources in the ledger until
+        released, and the raylet stays out of the per-task loop
+        entirely (2 messages/task instead of 6)."""
+        demand = dict(payload.get("resources") or {"CPU": 1.0})
+        if int(demand.get("TPU", 0) or 0):
+            return {"error": "LEASE_UNSUPPORTED",
+                    "message": "TPU tasks are not leasable (chips are "
+                               "granted per task)"}
+        self._lease_counter += 1
+        lease_tag = f"lease-{self.node_id[:8]}-{self._lease_counter}"
+        fut = asyncio.get_running_loop().create_future()
+        ptask = PendingTask({"task_id": lease_tag, "resources": demand},
+                            fut)
+        chips = self.led.acquire(ptask)
+        if chips is None:
+            return {"error": "LEASE_UNAVAILABLE",
+                    "message": "no free capacity for the lease demand"}
+        handle = self._pop_idle(_env_hash({}), ())
+        if handle is None:
+            try:
+                handle = await self._start_worker({}, ())
+            except Exception as e:
+                self._release_resources(ptask, chips)
+                return {"error": "WORKER_START_FAILED", "message": str(e)}
+            for lst in self.idle_workers.values():
+                if handle in lst:
+                    lst.remove(handle)
+        if conn._closed:
+            # the owner disconnected while we awaited the worker start:
+            # its _on_disconnect cleanup already ran (and saw no lease)
+            self._release_resources(ptask, chips)
+            self._push_idle(handle)
+            return {"error": "OWNER_DISCONNECTED",
+                    "message": "lease owner went away during grant"}
+        handle.leased_by = lease_tag
+        handle.busy_task = lease_tag  # reaper: busy != reapable
+        self._leases[lease_tag] = (handle, ptask, chips)
+        self._lease_owner_conns[lease_tag] = conn
+        conn.meta.setdefault("leases", []).append(lease_tag)
+        return {"lease_id": lease_tag, "worker_id": handle.worker_id,
+                "worker_address": handle.address}
+
+    async def handle_release_lease(self, payload, conn):
+        self._release_lease(payload.get("lease_id", ""))
+        return {}
+
+    async def _revoke_lease(self, lease_id: str):
+        """Ask the owner to stop using the lease, then reclaim it.  The
+        owner's in-flight pushes finish on the worker's serial queue;
+        new tasks fall back to its normal path."""
+        conn = self._lease_owner_conns.get(lease_id)
+        if conn is not None:
+            try:
+                await conn.notify("revoke_lease", {"lease_id": lease_id})
+            except Exception:
+                pass
+        self._release_lease(lease_id)
+
+    def _release_lease(self, lease_id: str):
+        entry = self._leases.pop(lease_id, None)
+        owner = self._lease_owner_conns.pop(lease_id, None)
+        if owner is not None:
+            # prune the per-connection list — it must not grow
+            # unboundedly across a long-lived driver's lease cycles
+            try:
+                owner.meta.get("leases", []).remove(lease_id)
+            except ValueError:
+                pass
+        if entry is None:
+            return
+        handle, ptask, chips = entry
+        self._release_resources(ptask, chips)
+        handle.leased_by = None
+        handle.busy_task = None
+        if handle.worker_id in self.workers and handle.proc.poll() is None:
+            self._push_idle(handle)
+
+    def _clean_leases_for_worker(self, worker_id: str):
+        """The leased worker died: refund the lease resources (the
+        handle itself is already being torn down)."""
+        for lid, (h, pt, ch) in list(self._leases.items()):
+            if h.worker_id == worker_id:
+                self._leases.pop(lid, None)
+                self._lease_owner_conns.pop(lid, None)
+                self._release_resources(pt, ch)
+
+    def _queue_dispatch_status(self, conn, status: Dict[str, Any]):
+        """Coalesce per-task dispatch statuses into one batched notify
+        per flush tick.  Failures flush immediately (retry latency);
+        successes are bookkeeping and ride the 2 ms coalescing window."""
+        entry = self._dispatch_status_buf.get(id(conn))
+        if entry is None:
+            entry = (conn, [])
+            self._dispatch_status_buf[id(conn)] = entry
+        entry[1].append(status)
+        if status.get("error"):
+            self._flush_dispatch_statuses()
+        elif not self._dispatch_status_flush_scheduled:
+            self._dispatch_status_flush_scheduled = True
+            asyncio.get_running_loop().call_later(
+                0.002, self._flush_dispatch_statuses)
+
+    def _flush_dispatch_statuses(self):
+        self._dispatch_status_flush_scheduled = False
+        bufs = self._dispatch_status_buf
+        if not bufs:
+            return
+        self._dispatch_status_buf = {}
+
+        async def _send(conn, statuses):
+            try:
+                await conn.notify("task_dispatch_status_batch",
+                                  {"statuses": statuses})
+            except Exception:
+                pass  # owner-side on_close handles a dead conn
+
+        for conn, statuses in bufs.values():
+            protocol.spawn(_send(conn, statuses))
 
     async def handle_task_done(self, payload, conn):
         task_id = payload["task_id"]

@@ -18,6 +18,19 @@ restore_tpu_plugin_env()
 async def main():
     logging.basicConfig(level=os.environ.get("RTPU_LOG_LEVEL", "INFO"))
     session_dir = os.environ["RTPU_SESSION_DIR"]
+    profiler = None
+    if os.environ.get("RTPU_CPROFILE_DIR") and \
+            "raylet" in os.environ.get("RTPU_CPROFILE_PROCS", "raylet"):
+        # perf-debug aid: RTPU_CPROFILE_DIR=/tmp/prof dumps a pstats
+        # file per process at exit (the driver can't see inside the
+        # raylet hot path any other way)
+        import cProfile
+        profiler = cProfile.Profile()
+        profiler.enable()
+        import atexit
+        atexit.register(lambda: profiler.dump_stats(os.path.join(
+            os.environ["RTPU_CPROFILE_DIR"],
+            f"raylet_{os.getpid()}.pstats")))
     from ray_tpu.util import events
     events.init_emitter("raylet", session_dir)
     node_id = os.environ["RTPU_NODE_ID"]

@@ -143,7 +143,12 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "trace_ctx": (_dict, False),
     },
     "submit_task_batch": {"specs": (_list, True)},
+    "task_dispatch_status_batch": {"statuses": (_list, True)},
     "task_done": {"task_id": (_str, True)},
+    "lease_worker": {"resources": (_dict, False)},
+    "release_lease": {"lease_id": (_str, True)},
+    "revoke_lease": {"lease_id": (_str, True)},
+    "leased_task": {"spec": (_dict, True)},
     "cancel_task": {"task_id": (_str, True)},
     "request_spill": {"bytes_needed": (_int, False)},
     # ---- raylet: object plane (object_manager.proto role)

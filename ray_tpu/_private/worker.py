@@ -363,6 +363,28 @@ class PendingTaskState:
         self.worker_address = None
 
 
+class _LeaseState:
+    """Driver-side record of one worker lease (reference:
+    normal_task_submitter.cc LeaseEntry).  `busy` is best-effort under
+    the GIL — two racing callers both landing on the lease just queue
+    serially at the worker, which is correct, only slower."""
+
+    __slots__ = ("key", "lease_id", "addr", "inflight", "last_used",
+                 "acquiring")
+
+    # pipeline depth per leased worker: execution is serial, so this
+    # just hides the RPC round-trip, it does not add parallelism
+    MAX_INFLIGHT = 8
+
+    def __init__(self, key):
+        self.key = key
+        self.lease_id = None
+        self.addr = None
+        self.inflight = 0
+        self.last_used = 0.0
+        self.acquiring = True  # constructed on the way to acquisition
+
+
 class Worker:
     def __init__(self):
         self.mode = MODE_DRIVER
@@ -393,6 +415,11 @@ class Worker:
         # io-loop only; see protocol.single_flight_connect
         self._peer_conns: Dict[str, protocol.Connection] = {}
         self._peer_pending: Dict[str, "asyncio.Future"] = {}
+        # worker-lease pools for direct pushes, keyed by sorted resource
+        # items; one pool entry per leased worker
+        self._worker_leases: Dict[Tuple, List["_LeaseState"]] = {}
+        self._lease_fail_at: Dict[Tuple, float] = {}
+        self._lease_waiters: Dict[Tuple, List[Tuple]] = {}
         self.session_dir = ""
         self.namespace = ""
         self.runtime_context: Dict[str, Any] = {}
@@ -520,7 +547,10 @@ class Worker:
             "task_result": self._h_task_result,
             "task_failed": self._h_task_failed,
             "task_dispatch_status": self._h_task_dispatch_status,
+            "task_dispatch_status_batch": self._h_task_dispatch_status_batch,
+            "revoke_lease": self._h_revoke_lease,
             "push_task": self._h_push_task,
+            "leased_task": self._h_leased_task,
             "become_actor": self._h_become_actor,
             "actor_call": self._h_actor_call,
             "cancel_task": self._h_cancel_task,
@@ -1056,7 +1086,7 @@ class Worker:
             scheduled = self._submit_flush_scheduled
             self._submit_flush_scheduled = True
         if not scheduled:
-            self.io.run_async(self._flush_submits())
+            self.io.call_soon(self._spawn_submit_flush)
         return out
 
     # ---- tracing: span propagation through task specs (reference:
@@ -1096,9 +1126,208 @@ class Worker:
             for hex_ref, _owner in spec.get("arg_refs", []):
                 self.reference_counter.add_submitted(ObjectID.from_hex(hex_ref))
 
-        self._enqueue_submit(spec, state)
+        if not self._try_leased_submit(spec, state):
+            self._enqueue_submit(spec, state)
         refs = [ObjectRef(oid, self.address) for oid in return_ids]
         return refs
+
+    # ---- worker leases: direct owner->worker pushes (reference:
+    # src/ray/core_worker/transport/normal_task_submitter.cc — the
+    # reference's normal-task path IS lease-based; this recovers it as a
+    # fast lane beside the GCS-routed default, cutting a no-dep CPU task
+    # from 6 messages across 3 processes to 2 messages total) ----
+
+    _LEASE_IDLE_RELEASE_S = 2.0
+    _LEASE_RETRY_COOLDOWN_S = 5.0
+    # the pool grows until the raylet denies the lease (LEASE_UNAVAILABLE),
+    # so its size naturally tracks node capacity; the cap is a sanity bound
+    _LEASE_POOL_MAX = 16
+    _LEASE_MAX_WAITERS = 512
+
+    def _lease_qualifies(self, spec) -> bool:
+        # plain CPU-only demands: custom resources imply placement on
+        # specific nodes (the local raylet may not even have them) and
+        # TPU chips are granted per task
+        return (not spec.get("plasma_deps")
+                and not spec.get("runtime_env")
+                and not spec.get("placement_group")
+                and not spec.get("scheduling")
+                and not spec.get("spilled_from")
+                and all(k == "CPU"
+                        for k in (spec.get("resources") or {})))
+
+    def _try_leased_submit(self, spec, state) -> bool:
+        """Caller-thread side: only qualification + cheap reads happen
+        here.  ALL lease state (pool, waiters, inflight) is mutated on
+        the io thread — a caller-thread append racing the io-side drain
+        silently orphaned parked tasks (round-5 review finding)."""
+        if not self._lease_qualifies(spec):
+            return False
+        key = tuple(sorted((spec.get("resources") or {}).items()))
+        pool = self._worker_leases.get(key)
+        if not pool and time.monotonic() - self._lease_fail_at.get(
+                key, 0.0) <= self._LEASE_RETRY_COOLDOWN_S:
+            return False  # leasing recently denied — normal path
+        self.io.call_soon(self._park_lease_waiter, key, spec, state)
+        return True
+
+    def cancel_leased_task(self, task_id: str):
+        """Cancel a task the raylet never saw: drop it from the parked
+        waiters, or send cancel_task straight to the leased worker it
+        was pushed to (runs the io-side work on the io thread)."""
+        state = self.pending_tasks.get(task_id)
+        if state is None or state.done:
+            return
+        self.io.call_soon(self._cancel_leased_io, task_id, state)
+
+    def _cancel_leased_io(self, task_id, state):
+        for key, waiters in list(self._lease_waiters.items()):
+            for item in waiters:
+                if item[0]["task_id"] == task_id:
+                    waiters.remove(item)
+                    err = exc.TaskCancelledError(task_id)
+                    ser = serialization.serialize_error(err)
+                    for oid in state.return_ids:
+                        self.memory_store.put(oid, ser.to_bytes())
+                    state.done = True
+                    state.result_event.set()
+                    return
+        if state.worker_address:
+            async def _send():
+                try:
+                    conn = await self._peer(state.worker_address)
+                    await conn.notify("cancel_task", {"task_id": task_id})
+                except Exception:
+                    pass  # worker gone — the task is dead anyway
+            protocol.spawn(_send())
+
+    def _park_lease_waiter(self, key, spec, state):
+        """io thread: grow the pool if useful, park the task, drain."""
+        pool = self._worker_leases.get(key)
+        if pool is None:
+            pool = []
+            self._worker_leases[key] = pool
+        best = None
+        acquiring = False
+        for L in pool:
+            if L.acquiring:
+                acquiring = True
+            elif L.addr is not None and (best is None
+                                         or L.inflight < best.inflight):
+                best = L
+        # grow when empty or saturated (each lease is one serial worker;
+        # grow-until-denied sizes the pool to node capacity)
+        if (best is None or best.inflight >= 2) \
+                and len(pool) < self._LEASE_POOL_MAX and not acquiring:
+            if time.monotonic() - self._lease_fail_at.get(key, 0.0) > \
+                    self._LEASE_RETRY_COOLDOWN_S:
+                L = _LeaseState(key)
+                pool.append(L)
+                protocol.spawn(self._acquire_lease(
+                    L, dict(spec.get("resources") or {})))
+        waiters = self._lease_waiters.setdefault(key, [])
+        if len(waiters) >= self._LEASE_MAX_WAITERS:
+            self._enqueue_submit(spec, state)  # overflow: batched path
+            return
+        waiters.append((spec, state))
+        self._drain_lease_waiters(key)
+
+    async def _acquire_lease(self, L, resources):
+        try:
+            r = await self.raylet.call("lease_worker",
+                                       {"resources": resources})
+        except Exception as e:  # noqa: BLE001
+            r = {"error": "LEASE_RPC_FAILED", "message": str(e)}
+        L.acquiring = False
+        if r.get("error"):
+            self._lease_fail_at[L.key] = time.monotonic()
+            pool = self._worker_leases.get(L.key)
+            if pool and L in pool:
+                pool.remove(L)
+            self._drain_lease_waiters(L.key)
+            return
+        L.lease_id = r["lease_id"]
+        L.addr = r["worker_address"]
+        L.last_used = time.monotonic()
+        self.io.loop.call_later(self._LEASE_IDLE_RELEASE_S,
+                                self._lease_idle_check, L)
+        self._drain_lease_waiters(L.key)
+
+    def _drain_lease_waiters(self, key):
+        """Route parked tasks (io thread only).  Feed ready leases up to
+        their pipeline depth; keep the rest parked while an acquisition
+        is in flight or any lease exists (completions re-drain); flush
+        to the normal path only when the pool is gone."""
+        waiters = self._lease_waiters.get(key)
+        if not waiters:
+            return
+        pool = self._worker_leases.get(key) or []
+        ready = [L for L in pool if L.addr is not None]
+        if not ready:
+            if any(L.acquiring for L in pool):
+                return  # stay parked; the acquisition settles the drain
+            self._lease_waiters.pop(key, None)
+            for spec, state in waiters:
+                self._enqueue_submit(spec, state)
+            return
+        while waiters:
+            L = min(ready, key=lambda x: x.inflight)
+            if L.inflight >= L.MAX_INFLIGHT:
+                break  # completions call back into this drain
+            spec, state = waiters.pop(0)
+            L.inflight += 1
+            L.last_used = time.monotonic()
+            protocol.spawn(self._leased_call(L, spec, state))
+        if not waiters:
+            self._lease_waiters.pop(key, None)
+
+    def _lease_idle_check(self, L):
+        """Release an idle lease so it stops pinning cluster capacity."""
+        if L.addr is None:
+            return
+        idle = time.monotonic() - L.last_used
+        if L.inflight or idle < self._LEASE_IDLE_RELEASE_S:
+            self.io.loop.call_later(
+                max(0.2, self._LEASE_IDLE_RELEASE_S - idle),
+                self._lease_idle_check, L)
+            return
+        self._drop_lease(L, release=True)
+
+    def _drop_lease(self, L, release: bool = False):
+        lease_id, L.lease_id, L.addr = L.lease_id, None, None
+        pool = self._worker_leases.get(L.key)
+        if pool and L in pool:
+            pool.remove(L)
+        self._drain_lease_waiters(L.key)  # re-route or flush parked tasks
+        if release and lease_id is not None:
+            async def _rel():
+                try:
+                    await self.raylet.call("release_lease",
+                                           {"lease_id": lease_id})
+                except Exception:
+                    pass  # raylet-side conn cleanup is the backstop
+            protocol.spawn(_rel())
+
+    async def _leased_call(self, L, spec, state):
+        state.worker_address = L.addr
+        try:
+            conn = await self._peer(L.addr)
+            reply = await conn.call("leased_task", {"spec": spec})
+        except Exception:
+            # lease broken (worker died / revoked / dial failed): drop
+            # it — WITH a release RPC, which is idempotent raylet-side
+            # and reclaims the resources when only the owner->worker
+            # dial was at fault — and fall back to the normal path
+            # (at-least-once, same as the task-retry contract)
+            L.inflight -= 1
+            self._drop_lease(L, release=True)
+            state.worker_address = None  # else _fail_pending skips it
+            self._enqueue_submit(spec, state)
+            return
+        L.inflight -= 1
+        L.last_used = time.monotonic()
+        self._drain_lease_waiters(L.key)
+        await self._h_task_result(reply, None)
 
     # Micro-batched submission: specs enqueued between IO-loop ticks ride
     # ONE submit_task_batch RPC (reference gets its tasks/s the same way —
@@ -1112,7 +1341,11 @@ class Worker:
             if self._submit_flush_scheduled:
                 return
             self._submit_flush_scheduled = True
-        self.io.run_async(self._flush_submits())
+        self.io.call_soon(self._spawn_submit_flush)
+
+    def _spawn_submit_flush(self):
+        from ray_tpu._private.protocol import spawn
+        spawn(self._flush_submits())
 
     async def _flush_submits(self):
         while True:
@@ -1138,6 +1371,26 @@ class Worker:
         state = self.pending_tasks.get(payload.get("task_id"))
         if state is not None and not state.done:
             self._on_submit_reply(state, payload)
+        return {}
+
+    async def _h_revoke_lease(self, payload, conn):
+        """The raylet reclaims a lease under contention: stop routing new
+        tasks through it (in-flight calls finish on the worker's serial
+        queue) and back off before re-acquiring."""
+        lease_id = payload.get("lease_id")
+        for pool in self._worker_leases.values():
+            for L in list(pool):
+                if L.lease_id == lease_id:
+                    self._lease_fail_at[L.key] = time.monotonic()
+                    self._drop_lease(L)  # raylet already released it
+                    return {}
+        return {}
+
+    async def _h_task_dispatch_status_batch(self, payload, conn):
+        """Coalesced form: one notify carrying many statuses (the raylet
+        batches success statuses per flush tick)."""
+        for status in payload.get("statuses") or ():
+            await self._h_task_dispatch_status(status, conn)
         return {}
 
     def _fail_pending_submissions(self, err: str, message: str):
@@ -1358,6 +1611,16 @@ class Worker:
         self._task_queue.put(payload)
         return {}
 
+    async def _h_leased_task(self, payload, conn):
+        """Direct owner->worker execution under a lease: the reply IS
+        the result delivery (2 messages/task; no raylet involvement —
+        the lease holds the resources)."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._task_queue.put({"spec": payload["spec"], "tpu_chips": [],
+                              "reply": (loop, fut)})
+        return await fut
+
     async def _h_cancel_task(self, payload, conn):
         self._cancelled_tasks.add(payload["task_id"])
         return {}
@@ -1369,9 +1632,10 @@ class Worker:
             item = self._task_queue.get()
             if item is None:
                 break
-            self._execute_task(item["spec"], item.get("tpu_chips") or [])
+            self._execute_task(item["spec"], item.get("tpu_chips") or [],
+                               reply=item.get("reply"))
 
-    def _execute_task(self, spec, tpu_chips):
+    def _execute_task(self, spec, tpu_chips, reply=None):
         task_hex = spec["task_id"]
         self.current_task_id = TaskID(bytes.fromhex(task_hex))
         self.tpu_chips = tpu_chips
@@ -1424,6 +1688,15 @@ class Worker:
                                   time.time(), pid=os.getpid(),
                                   failed=app_error,
                                   trace_ctx=spec.get("trace_ctx"))
+        if reply is not None:
+            # leased task: the RPC reply carries the result (no owner
+            # notify, no task_done — the lease holds the resources)
+            loop, fut = reply
+            result = {"task_id": task_hex, "returns": returns,
+                      "app_error": app_error}
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(result))
+            return
         # Deliver the result BEFORE task_done: for TPU tasks the raylet
         # retires (kills) this worker as soon as task_done arrives, so a
         # fire-and-forget result here races worker death and the owner would
@@ -1440,8 +1713,10 @@ class Worker:
             logger.warning("result delivery for %s failed", task_hex,
                            exc_info=True)
         if self.raylet is not None:
-            self.io.run_async(self.raylet.call("task_done",
-                                               {"task_id": task_hex}))
+            # notify, not call: the raylet never replies with anything —
+            # a request would cost an extra send + seq bookkeeping per task
+            self.io.run_async(self.raylet.notify("task_done",
+                                                 {"task_id": task_hex}))
 
     def _ship_return(self, oid: ObjectID, ser) -> Dict[str, Any]:
         if ser.total_size <= self.config.max_inline_object_size:
