@@ -1,7 +1,12 @@
 """ray_tpu.workflow — durable DAG execution (reference: workflow/)."""
 
-from ray_tpu.workflow.api import (get_output, get_status, list_workflows,
-                                  resume, run, run_async, set_storage)
+from ray_tpu.workflow.api import (WorkflowCancelledError, cancel,
+                                  get_output, get_status, list_all,
+                                  list_workflows, resume, resume_all, run,
+                                  run_async, set_storage, wait_for_event)
+from ray_tpu.workflow.event_listener import EventListener, TimerListener
 
-__all__ = ["run", "run_async", "resume", "get_status", "get_output",
-           "list_workflows", "set_storage"]
+__all__ = ["run", "run_async", "resume", "resume_all", "get_status",
+           "get_output", "cancel", "list_all", "wait_for_event",
+           "list_workflows", "set_storage", "WorkflowCancelledError",
+           "EventListener", "TimerListener"]

@@ -51,6 +51,10 @@ def _step_id(node: DAGNode, child_ids: List[str]) -> str:
             + hashlib.sha1(payload.encode()).hexdigest()[:10])
 
 
+class WorkflowCancelledError(Exception):
+    """The workflow was cancelled (workflow.cancel) between steps."""
+
+
 class _StepExec:
     """Recursive executor materializing one step at a time (children
     first), checkpointing each result."""
@@ -59,6 +63,15 @@ class _StepExec:
         self.storage = storage
         self.input_value = input_value
         self._memo: Dict[int, Any] = {}
+
+    def _check_cancelled(self):
+        st = self.storage.load_status()
+        if st and st.get("status") == "CANCELED":
+            raise WorkflowCancelledError(self.storage.workflow_id)
+        # liveness claim: refreshed before every step launch so
+        # resume_all can tell a crashed RUNNING workflow (stale claim)
+        # from one actively executing in a live driver
+        self.storage.touch_claim()
 
     def run(self, node: Any) -> Any:
         if not isinstance(node, DAGNode):
@@ -90,7 +103,12 @@ class _StepExec:
         sid = _step_id(node, child_ids)
         if self.storage.has_step_result(sid):
             value = self.storage.load_step_result(sid)
+            # re-run the post-commit hook: a crash between checkpoint
+            # and ack must re-ack on resume (at-least-once ack — the
+            # __acked marker inside makes the completed case a no-op)
+            self._post_commit(node, sid, value)
         else:
+            self._check_cancelled()
             if isinstance(node, FunctionNode):
                 ref = node._remote_fn._remote(
                     tuple(resolved_args), resolved_kwargs, node._opts)
@@ -101,8 +119,25 @@ class _StepExec:
                     f"{type(node).__name__} (actor nodes are not "
                     f"durable)")
             self.storage.save_step_result(sid, value)
+            self._post_commit(node, sid, value)
         self._memo[key] = value
         return value
+
+    def _post_commit(self, node, sid: str, value: Any):
+        """Event steps: ack the listener AFTER the payload is durable
+        (reference: event_listener.event_checkpointed).  The ack is
+        recorded so a resume doesn't re-ack a completed event; a crash
+        between checkpoint and ack re-acks on resume (at-least-once
+        ack, exactly-once payload — the reference's contract)."""
+        listener_cls = getattr(node, "_event_listener", None)
+        if listener_cls is None:
+            return
+        ack_id = sid + "__acked"
+        if self.storage.has_step_result(ack_id):
+            return
+        from ray_tpu.workflow.event_listener import _ack_listener
+        _ack_listener(listener_cls, value)
+        self.storage.save_step_result(ack_id, True)
 
     def _run_child(self, node: DAGNode):
         if not hasattr(self, "_fp_cache"):
@@ -141,6 +176,8 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         storage.save_step_result("__result__", result)
         storage.save_status("SUCCESSFUL")
         return result
+    except WorkflowCancelledError:
+        raise  # status already CANCELED — don't overwrite with FAILED
     except Exception as e:
         storage.save_status("FAILED", {"error": repr(e)})
         raise
@@ -174,6 +211,11 @@ def resume(workflow_id: str) -> Any:
     storage = WorkflowStorage(workflow_id)
     if storage.has_step_result("__result__"):
         return storage.load_step_result("__result__")
+    st = storage.load_status()
+    if st and st.get("status") == "CANCELED":
+        raise WorkflowCancelledError(
+            f"workflow {workflow_id!r} was cancelled; resuming would "
+            "silently undo the cancellation")
     blob = storage.load_dag()
     if blob is None:
         raise ValueError(f"workflow {workflow_id!r} has no persisted DAG")
@@ -193,5 +235,55 @@ def get_output(workflow_id: str) -> Any:
     return storage.load_step_result("__result__")
 
 
+def cancel(workflow_id: str) -> bool:
+    """Stop a running workflow between steps (reference:
+    workflow/api.py cancel — the executor checks before every step
+    launch and raises WorkflowCancelledError)."""
+    storage = WorkflowStorage(workflow_id)
+    st = storage.load_status()
+    if st is None or st["status"] in ("SUCCESSFUL", "FAILED", "CANCELED"):
+        return False
+    storage.save_status("CANCELED")
+    return True
+
+
+def resume_all() -> List[str]:
+    """Resume every workflow left RUNNING by crashed drivers, through
+    the management actor (reference: workflow_access.py:88)."""
+    from ray_tpu.workflow.workflow_access import get_management_actor
+    actor = get_management_actor()
+    return ray_tpu.get(actor.resume_all.remote())
+
+
+def list_all(status_filter: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Status rows for every persisted workflow."""
+    rows = list_workflows()
+    if status_filter:
+        rows = [r for r in rows if r.get("status") == status_filter]
+    return rows
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
+    """A DAG node that completes when the listener's event arrives; the
+    event payload is checkpointed like any step result, and the
+    listener's ``event_checkpointed`` ack runs after that durable write
+    (reference: api.wait_for_event + event_listener.py)."""
+    from ray_tpu.workflow.event_listener import (EventListener,
+                                                 _poll_listener)
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener "
+                        f"subclass, got {listener_cls!r}")
+
+    @ray_tpu.remote
+    def _event_step(cls, a, kw):
+        return _poll_listener(cls, a, kw)
+
+    node = _event_step.bind(listener_cls, list(args), kwargs)
+    node._event_listener = listener_cls
+    return node
+
+
 __all__ = ["run", "run_async", "resume", "get_status", "get_output",
-           "list_workflows", "set_storage"]
+           "cancel", "resume_all", "list_all", "wait_for_event",
+           "list_workflows", "set_storage", "WorkflowCancelledError"]

@@ -1,0 +1,48 @@
+"""Workflow event listeners.
+
+Reference analogue: workflow/event_listener.py (EventListener ABC +
+TimerListener) and api.wait_for_event — a workflow step that completes
+when an EXTERNAL event arrives.  The event's payload is checkpointed
+exactly like a step result, so a resumed workflow does not wait for
+(or observe) the event twice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+
+class EventListener:
+    """Subclass and implement ``poll_for_event`` (async).  The listener
+    is constructed INSIDE the event step's worker, so it must be
+    picklable by class reference (module-level class)."""
+
+    async def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    async def event_checkpointed(self, event: Any) -> None:
+        """Optional commit hook: called after the event's payload has
+        been durably checkpointed (e.g. ack a message queue)."""
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (reference:
+    event_listener.TimerListener)."""
+
+    async def poll_for_event(self, fire_at: float) -> float:
+        await asyncio.sleep(max(0.0, fire_at - time.time()))
+        return fire_at
+
+
+def _poll_listener(listener_cls, args, kwargs):
+    """Step body: construct the listener and wait for its event."""
+    listener = listener_cls()
+    return asyncio.run(listener.poll_for_event(*args, **kwargs))
+
+
+def _ack_listener(listener_cls, event):
+    """Post-checkpoint commit hook (see api._post_commit)."""
+    listener = listener_cls()
+    asyncio.run(listener.event_checkpointed(event))

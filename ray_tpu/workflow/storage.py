@@ -82,6 +82,22 @@ class WorkflowStorage:
         with open(p) as f:
             return json.load(f)
 
+    def touch_claim(self):
+        """Liveness stamp from the executing driver (refreshed between
+        steps); resume_all only resumes RUNNING workflows whose claim
+        has gone stale."""
+        p = os.path.join(self.dir, "claim")
+        with open(p, "w") as f:
+            f.write(str(os.getpid()))
+
+    def claim_age(self) -> Optional[float]:
+        p = os.path.join(self.dir, "claim")
+        try:
+            import time
+            return time.time() - os.path.getmtime(p)
+        except OSError:
+            return None
+
     def save_dag(self, dag_bytes: bytes):
         self._write(os.path.join(self.dir, "dag.pkl"), dag_bytes)
 

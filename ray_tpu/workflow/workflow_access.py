@@ -1,0 +1,118 @@
+"""Workflow management actor.
+
+Reference analogue: workflow/workflow_access.py:88
+(WorkflowManagementActor) — one named detached actor per cluster owns
+workflow lifecycle: submitting runs, status/list queries, cancellation,
+and crash recovery (resume_all).  Storage stays the source of truth
+(steps/status on the cluster storage root); the actor adds the LIVE
+view (what is currently executing) and a single place to drive
+recovery from.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+MANAGEMENT_ACTOR_NAME = "__workflow_management_actor__"
+
+
+@ray_tpu.remote
+class WorkflowManagementActor:
+    """Cluster-singleton bookkeeping for workflows (detached, named)."""
+
+    def __init__(self, storage_root: str):
+        from ray_tpu.workflow.storage import set_storage
+        set_storage(storage_root)
+        self._storage_root = storage_root
+        self._running: Dict[str, Any] = {}  # workflow_id -> ObjectRef
+
+    def submit(self, blob: bytes, workflow_id: str) -> str:
+        """Start a pickled (dag, input) workflow asynchronously."""
+        ref = _workflow_driver.remote(blob, workflow_id,
+                                      self._storage_root)
+        self._running[workflow_id] = ref
+        return workflow_id
+
+    def get_output_ref(self, workflow_id: str):
+        """ObjectRef of a run submitted through this actor (wrapped in
+        a list so the caller receives the ref, not its value)."""
+        ref = self._running.get(workflow_id)
+        return [ref] if ref is not None else None
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        from ray_tpu.workflow.storage import WorkflowStorage
+        st = WorkflowStorage(workflow_id, self._storage_root).load_status()
+        return st["status"] if st else None
+
+    def list_all(self, status_filter: Optional[str] = None
+                 ) -> List[Dict[str, Any]]:
+        from ray_tpu.workflow import api
+        return api.list_all(status_filter)  # single source of truth
+
+    def cancel(self, workflow_id: str) -> bool:
+        """Mark CANCELED; the executor checks between steps and stops.
+        (reference: workflow_access cancel + the executor's
+        per-step cancellation check)."""
+        from ray_tpu.workflow import api
+        ok = api.cancel(workflow_id)
+        self._running.pop(workflow_id, None)
+        return ok
+
+    # a claim younger than this means a live driver is executing the
+    # workflow right now — resuming it would double-run steps
+    _CLAIM_FRESH_S = 10.0
+
+    def resume_all(self) -> List[str]:
+        """Restart every workflow left RUNNING by a CRASHED driver —
+        live ones (fresh liveness claim) are left alone."""
+        from ray_tpu.workflow.storage import (WorkflowStorage,
+                                              list_workflows)
+        resumed = []
+        for row in list_workflows(self._storage_root):
+            wid = row.get("workflow_id")
+            if row.get("status") != "RUNNING" or wid in self._running:
+                continue
+            storage = WorkflowStorage(wid, self._storage_root)
+            age = storage.claim_age()
+            if age is not None and age < self._CLAIM_FRESH_S:
+                continue  # an alive executor owns it
+            blob = storage.load_dag()
+            if blob is None:
+                continue
+            self._running[wid] = _workflow_driver.remote(
+                blob, wid, self._storage_root)
+            resumed.append(wid)
+        return resumed
+
+    def ping(self) -> str:
+        return "ok"
+
+
+@ray_tpu.remote(max_retries=0)
+def _workflow_driver(blob: bytes, workflow_id: str, storage_root: str):
+    import cloudpickle as cp
+
+    from ray_tpu.workflow import api as wf_api
+    from ray_tpu.workflow.storage import set_storage
+    set_storage(storage_root)
+    dag, input_value = cp.loads(blob)
+    return wf_api.run(dag, workflow_id=workflow_id,
+                      input_value=input_value)
+
+
+def get_management_actor():
+    """The cluster's management actor, creating it on first use."""
+    from ray_tpu.workflow.storage import get_storage
+    try:
+        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+    except Exception:
+        pass
+    try:
+        return WorkflowManagementActor.options(
+            name=MANAGEMENT_ACTOR_NAME, lifetime="detached").remote(
+            get_storage())
+    except Exception:
+        # creation raced another driver — the name now resolves
+        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
