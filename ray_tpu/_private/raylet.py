@@ -353,6 +353,7 @@ class Raylet:
             "cancel_task": self.handle_cancel_task,
             "lease_worker": self.handle_lease_worker,
             "release_lease": self.handle_release_lease,
+            "task_stats": self.handle_task_stats,
             "_on_disconnect": self._on_disconnect,
         }
 
@@ -1017,6 +1018,12 @@ class Raylet:
 
     async def handle_release_lease(self, payload, conn):
         self._release_lease(payload.get("lease_id", ""))
+        return {}
+
+    async def handle_task_stats(self, payload, conn):
+        """Leased workers report executed-task deltas so the node's
+        dispatch gauges stay truthful for work the raylet never saw."""
+        self._tasks_dispatched_total += int(payload.get("executed", 0))
         return {}
 
     async def _revoke_lease(self, lease_id: str):

@@ -148,6 +148,7 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "lease_worker": {"resources": (_dict, False)},
     "release_lease": {"lease_id": (_str, True)},
     "revoke_lease": {"lease_id": (_str, True)},
+    "task_stats": {"executed": (_int, True)},
     "leased_task": {"spec": (_dict, True)},
     "cancel_task": {"task_id": (_str, True)},
     "request_spill": {"bytes_needed": (_int, False)},

@@ -425,6 +425,8 @@ class Worker:
         self.runtime_context: Dict[str, Any] = {}
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
+        self._leased_executed = 0
+        self._leased_stats_scheduled = False
         self._actor_instance = None
         self._actor_threads: Optional[ThreadPoolExecutor] = None
         self._actor_lock = threading.Lock()
@@ -1619,7 +1621,22 @@ class Worker:
         fut = loop.create_future()
         self._task_queue.put({"spec": payload["spec"], "tpu_chips": [],
                               "reply": (loop, fut)})
-        return await fut
+        result = await fut
+        # leased tasks bypass the raylet, so its tasks_dispatched gauge
+        # would go dark — coalesce executed-count deltas into one
+        # task_stats notify per 0.3 s tick
+        self._leased_executed += 1
+        if not self._leased_stats_scheduled:
+            self._leased_stats_scheduled = True
+            loop.call_later(0.3, self._flush_leased_stats)
+        return result
+
+    def _flush_leased_stats(self):
+        self._leased_stats_scheduled = False
+        delta, self._leased_executed = self._leased_executed, 0
+        if delta and self.raylet is not None:
+            protocol.spawn(self.raylet.notify(
+                "task_stats", {"executed": delta}))
 
     async def _h_cancel_task(self, payload, conn):
         self._cancelled_tasks.add(payload["task_id"])

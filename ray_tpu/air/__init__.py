@@ -6,7 +6,8 @@ from ray_tpu.air.config import (  # noqa: F401
 from ray_tpu.air import session  # noqa: F401
 from ray_tpu.air.session import TrainingResult  # noqa: F401
 from ray_tpu.air.preprocessor import (  # noqa: F401
-    BatchMapper, Chain, LabelEncoder, MinMaxScaler, Preprocessor,
-    StandardScaler)
+    BatchMapper, Chain, Concatenator, LabelEncoder, MaxAbsScaler,
+    MinMaxScaler, Normalizer, OneHotEncoder, OrdinalEncoder,
+    Preprocessor, RobustScaler, SimpleImputer, StandardScaler)
 from ray_tpu.air.batch_predictor import (  # noqa: F401
     BatchPredictor, JaxPredictor, Predictor)

@@ -73,18 +73,36 @@ class BatchPredictor:
                         **predictor_kwargs) -> "BatchPredictor":
         return cls(checkpoint, predictor_cls, **predictor_kwargs)
 
-    def predict(self, dataset, *, batch_size: int = 256):
+    def predict(self, dataset, *, batch_size: int = 256,
+                num_workers: Optional[int] = None,
+                apply_preprocessor: bool = True):
         """Run inference over every batch of the dataset; returns a new
-        Dataset with the prediction column appended."""
+        Dataset with the prediction column appended.
+
+        ``num_workers`` shards the work over a Dataset actor pool (each
+        actor holds ONE predictor instance — the model loads once per
+        worker, not once per block).  A preprocessor attached to the
+        checkpoint (``Checkpoint.with_preprocessor``) is applied to each
+        batch first, so inference sees the training-time features
+        (reference: BatchPredictor.predict + separate_gpu_stage)."""
         checkpoint = self.checkpoint
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
+        preprocessor = checkpoint.get_preprocessor() \
+            if apply_preprocessor else None
         state = {"p": None}
 
         def _predict(batch):
-            if state["p"] is None:  # one predictor per worker process
+            if state["p"] is None:  # one predictor per worker/actor
                 state["p"] = predictor_cls.from_checkpoint(
                     checkpoint, **kwargs)
+            if preprocessor is not None:
+                batch = preprocessor.transform_batch(batch)
             return state["p"].predict(batch)
 
-        return dataset.map_batches(_predict, batch_size=batch_size)
+        compute = None
+        if num_workers is not None:
+            from ray_tpu.data._internal.compute import ActorPoolStrategy
+            compute = ActorPoolStrategy(size=num_workers)
+        return dataset.map_batches(_predict, batch_size=batch_size,
+                                   compute=compute)

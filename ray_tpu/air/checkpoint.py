@@ -78,6 +78,24 @@ class Checkpoint:
 
     # ----------------------------------------------------------- state/value
 
+    # -------------------------------------------------------- preprocessor
+
+    _PREPROCESSOR_KEY = "_preprocessor"
+
+    def with_preprocessor(self, preprocessor) -> "Checkpoint":
+        """Attach a fitted preprocessor so inference applies the exact
+        training-time transform (reference: air/checkpoint.py
+        get_preprocessor — the preprocessor rides the checkpoint)."""
+        import cloudpickle
+        data = self.to_dict()
+        data[self._PREPROCESSOR_KEY] = cloudpickle.dumps(preprocessor)
+        return Checkpoint.from_dict(data)
+
+    def get_preprocessor(self):
+        import cloudpickle
+        blob = self.to_dict().get(self._PREPROCESSOR_KEY)
+        return cloudpickle.loads(blob) if blob is not None else None
+
     def get(self, key: str, default=None):
         return self.to_dict().get(key, default)
 

@@ -171,8 +171,28 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         storage.save_dag(cloudpickle.dumps((dag, input_value)))
     except Exception:
         pass  # non-picklable DAGs can still run, just not resume cold
+    # background liveness claim: per-step touches alone go stale inside
+    # any step longer than resume_all's freshness window, making a LIVE
+    # workflow look crashed (double-run)
+    import threading
+    stop_claim = threading.Event()
+
+    def _claim_loop():
+        while not stop_claim.wait(3.0):
+            try:
+                storage.touch_claim()
+            except OSError:
+                pass
+    storage.touch_claim()
+    claimer = threading.Thread(target=_claim_loop, daemon=True)
+    claimer.start()
     try:
         result = _StepExec(storage, input_value).run(dag)
+        st = storage.load_status()
+        if st and st.get("status") == "CANCELED":
+            # cancelled during the final step: honor the cancel —
+            # a CANCELED -> SUCCESSFUL transition must not exist
+            raise WorkflowCancelledError(workflow_id)
         storage.save_step_result("__result__", result)
         storage.save_status("SUCCESSFUL")
         return result
@@ -181,6 +201,8 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     except Exception as e:
         storage.save_status("FAILED", {"error": repr(e)})
         raise
+    finally:
+        stop_claim.set()
 
 
 def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,

@@ -18,6 +18,15 @@ import ray_tpu
 MANAGEMENT_ACTOR_NAME = "__workflow_management_actor__"
 
 
+def _actor_name_for_root(root: str) -> str:
+    """One management actor PER STORAGE ROOT: a single global actor
+    pinned to its creation-time root would answer queries for callers
+    using a different set_storage() root from the wrong tree."""
+    import hashlib
+    return MANAGEMENT_ACTOR_NAME + hashlib.sha1(
+        root.encode()).hexdigest()[:8]
+
+
 @ray_tpu.remote
 class WorkflowManagementActor:
     """Cluster-singleton bookkeeping for workflows (detached, named)."""
@@ -64,11 +73,21 @@ class WorkflowManagementActor:
     # workflow right now — resuming it would double-run steps
     _CLAIM_FRESH_S = 10.0
 
+    def _prune_running(self):
+        """Drop finished/crashed driver refs: a dead entry would make
+        resume_all skip its workflow forever, and the retained refs pin
+        results in the object store."""
+        for wid, ref in list(self._running.items()):
+            ready, _ = ray_tpu.wait([ref], timeout=0)
+            if ready:
+                self._running.pop(wid, None)
+
     def resume_all(self) -> List[str]:
         """Restart every workflow left RUNNING by a CRASHED driver —
         live ones (fresh liveness claim) are left alone."""
         from ray_tpu.workflow.storage import (WorkflowStorage,
                                               list_workflows)
+        self._prune_running()
         resumed = []
         for row in list_workflows(self._storage_root):
             wid = row.get("workflow_id")
@@ -103,16 +122,18 @@ def _workflow_driver(blob: bytes, workflow_id: str, storage_root: str):
 
 
 def get_management_actor():
-    """The cluster's management actor, creating it on first use."""
+    """The management actor for the CURRENT storage root, creating it
+    on first use."""
     from ray_tpu.workflow.storage import get_storage
+    root = get_storage()
+    name = _actor_name_for_root(root)
     try:
-        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+        return ray_tpu.get_actor(name)
     except Exception:
         pass
     try:
         return WorkflowManagementActor.options(
-            name=MANAGEMENT_ACTOR_NAME, lifetime="detached").remote(
-            get_storage())
+            name=name, lifetime="detached").remote(root)
     except Exception:
         # creation raced another driver — the name now resolves
-        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+        return ray_tpu.get_actor(name)

@@ -43,6 +43,99 @@ def test_batch_mapper(ray_start_shared):
         [i * 10 for i in range(10)]
 
 
+def test_imputer_encoders_scalers(ray_start_shared):
+    import ray_tpu.data as rdata
+    from ray_tpu.air import (MaxAbsScaler, OneHotEncoder, OrdinalEncoder,
+                             RobustScaler, SimpleImputer)
+    items = [{"a": float(i) if i % 3 else float("nan"),
+              "b": float(i - 5),
+              "c": ["x", "y", "z"][i % 3]} for i in range(30)]
+    ds = rdata.from_items(items)
+
+    imp = SimpleImputer(columns=["a"], strategy="mean").fit(ds)
+    vals = np.concatenate([np.atleast_1d(b["a"]) for b in
+                           imp.transform(ds).iter_batches()])
+    assert not np.isnan(vals).any()
+
+    enc = OrdinalEncoder(columns=["c"]).fit(ds)
+    rows = enc.transform(ds).take_all()
+    assert set(r["c"] for r in rows) == {0, 1, 2}
+
+    oh = OneHotEncoder(columns=["c"]).fit(ds)
+    rows = oh.transform(ds).take_all()
+    assert "c" not in rows[0] and rows[0]["c_onehot"].shape == (3,)
+    assert all(np.asarray(r["c_onehot"]).sum() == 1.0 for r in rows)
+
+    cat_items = [{"c": None if i % 5 == 0 else ["a", "b"][i % 2]}
+                 for i in range(20)]
+    cat_ds = rdata.from_items(cat_items)
+    cat_imp = SimpleImputer(columns=["c"],
+                            strategy="most_frequent").fit(cat_ds)
+    rows = cat_imp.transform(cat_ds).take_all()
+    assert all(r["c"] in ("a", "b") for r in rows)  # strings imputed
+
+    rs = RobustScaler(columns=["b"]).fit(ds)
+    vals = np.concatenate([np.atleast_1d(b["b"]) for b in
+                           rs.transform(ds).iter_batches()])
+    assert abs(float(np.median(vals))) < 1e-6
+
+    ma = MaxAbsScaler(columns=["b"]).fit(ds)
+    vals = np.concatenate([np.atleast_1d(b["b"]) for b in
+                           ma.transform(ds).iter_batches()])
+    assert float(np.abs(vals).max()) == pytest.approx(1.0)
+
+
+def test_normalizer_and_concatenator(ray_start_shared):
+    import ray_tpu.data as rdata
+    from ray_tpu.air import Chain, Concatenator, Normalizer
+    ds = rdata.from_items([{"f1": 3.0 * (i + 1), "f2": 4.0 * (i + 1)}
+                           for i in range(5)])
+    pre = Chain(Normalizer(columns=["f1", "f2"]),
+                Concatenator(columns=["f1", "f2"], output_column="x"))
+    rows = pre.fit_transform(ds).take_all()
+    for r in rows:
+        assert "f1" not in r and r["x"].shape == (2,)
+        assert float(np.linalg.norm(r["x"])) == pytest.approx(1.0,
+                                                              abs=1e-5)
+
+
+def test_fit_train_predict_e2e(ray_start_shared):
+    """fit -> train -> checkpoint(with preprocessor) -> BatchPredictor
+    over a Dataset actor pool — the full AIR loop the reference ships
+    (reference: air/examples batch prediction + preprocessor docs)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.air import (BatchPredictor, Chain, Checkpoint,
+                             Concatenator, JaxPredictor, StandardScaler)
+
+    rng = np.random.default_rng(0)
+    raw = [{"f1": float(v), "f2": float(v) * 3.0 + 1.0}
+           for v in rng.normal(5.0, 2.0, 64)]
+    ds = rdata.from_items(raw)
+    pre = Chain(StandardScaler(columns=["f1", "f2"]),
+                Concatenator(columns=["f1", "f2"], output_column="x"))
+    train_ds = pre.fit_transform(ds)
+
+    # "train" a 1-layer model on the preprocessed features: x @ w
+    xs = np.stack([r["x"] for r in train_ds.take_all()])
+    w, *_ = np.linalg.lstsq(xs, xs[:, :1], rcond=None)
+    ckpt = Checkpoint.from_dict({"params": {"w": w.astype(np.float32)}}
+                                ).with_preprocessor(pre)
+    assert ckpt.get_preprocessor() is not None
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor,
+                                        apply_fn=apply_fn,
+                                        input_column="x")
+    # RAW features in; the checkpoint's preprocessor normalizes inside
+    # the actor-pool workers
+    out = bp.predict(ds, batch_size=16, num_workers=2)
+    preds = [float(np.asarray(r["predictions"]).ravel()[0])
+             for r in out.take_all()]
+    assert len(preds) == 64 and all(np.isfinite(p) for p in preds)
+
+
 def test_jax_batch_predictor(ray_start_shared):
     import ray_tpu.data as rdata
     from ray_tpu.air import BatchPredictor, Checkpoint, JaxPredictor
