@@ -37,6 +37,7 @@ import logging
 import os
 import shutil
 import signal
+import random
 import subprocess
 import sys
 import tempfile
@@ -306,6 +307,8 @@ class Raylet:
         # coalesced task_dispatch_status notifies (conn-id -> (conn, [..]))
         self._dispatch_status_buf: Dict[int, Any] = {}
         self._dispatch_status_flush_scheduled = False
+        # outbound pull streams being served: (oid, conn-id) -> last ts
+        self._serving_pulls: Dict[Tuple[str, int], float] = {}
         # worker leases: owner-held workers for direct task pushes
         # (reference: normal_task_submitter.cc lease-based dispatch)
         self._leases: Dict[str, Any] = {}
@@ -428,6 +431,12 @@ class Raylet:
     async def _on_disconnect(self, conn):
         for lease_id in conn.meta.get("leases", ()):
             self._release_lease(lease_id)  # owner died holding leases
+        # free this reader's outbound-pull serve slots: a leaked slot
+        # makes an idle source answer "busy" until the stale sweep
+        cid = id(conn)
+        for k in list(self._serving_pulls):
+            if k[1] == cid:
+                self._serving_pulls.pop(k, None)
         wid = conn.meta.get("worker_id")
         if wid:
             await self._handle_worker_death(wid, "connection lost")
@@ -1262,8 +1271,16 @@ class Raylet:
         are thousands of multi-MiB memcpys, and doing them inline
         starves the event loop for tens of seconds (long enough that
         in-loop heartbeats used to miss the GCS death timeout — the
-        full-size broadcast regression)."""
+        full-size broadcast regression).
+
+        Outbound streams are CAPPED (object_serve_concurrency): a new
+        reader over the limit gets "busy" and retries elsewhere — with
+        every completed pull registering a new source, a broadcast
+        fans out as a tree instead of serializing N readers on the
+        object's first holder (reference: push_manager.cc)."""
         oid = ObjectID.from_hex(payload["object_id"])
+        offset = payload.get("offset", 0)
+        stream_key = (oid.hex(), id(conn))
         buf = self.store.get_buffer(oid)
         if buf is None and oid.hex() in self.spilled:
             await self._restore_spilled(oid)
@@ -1271,11 +1288,28 @@ class Raylet:
         if buf is None:
             return {"found": False}
         try:
-            offset = payload.get("offset", 0)
-            n = min(payload.get("length", CHUNK), len(buf) - offset)
+            total = len(buf)
+            # the stream cap only pays for LONG transfers (the tree
+            # needs generations to grow; for small objects the
+            # busy-retry latency costs more than head serialization)
+            if offset == 0 and \
+                    total >= self.config.object_serve_tree_min_bytes:
+                now = time.monotonic()
+                for k, ts in list(self._serving_pulls.items()):
+                    if now - ts > 10.0:  # reader abandoned mid-pull
+                        self._serving_pulls.pop(k, None)
+                limit = self.config.object_serve_concurrency
+                if stream_key not in self._serving_pulls and \
+                        len(self._serving_pulls) >= limit:
+                    return {"found": True, "busy": True}
+            n = min(payload.get("length", CHUNK), total - offset)
+            if offset + n >= total:
+                self._serving_pulls.pop(stream_key, None)  # last chunk
+            elif total >= self.config.object_serve_tree_min_bytes:
+                self._serving_pulls[stream_key] = time.monotonic()
             data = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: bytes(buf[offset:offset + n]))
-            return {"found": True, "total_size": len(buf), "data": data}
+            return {"found": True, "total_size": total, "data": data}
         finally:
             buf.release()
             self.store.release(oid)
@@ -1342,98 +1376,137 @@ class Raylet:
             if locs or attempt == 5:
                 break
             await asyncio.sleep(0.5 * (attempt + 1))
-        # one deadline for the WHOLE fetch (spanning both replica
+        # one deadline for the WHOLE fetch (spanning all replica
         # passes): each push-join below consumes from it rather than
         # re-arming, so a fetch can never exceed the advertised bound
         join_deadline = time.monotonic() + self.config.arg_fetch_timeout_s
         last_err = None
-        # two passes: a replica skipped because a (then-live, since
-        # reaped) inbound push held the slot deserves one retry
-        for loc in locs + locs:
-            try:
-                remote = await protocol.connect(loc["raylet_address"])
+        # Tree broadcast (reference: push_manager.cc's role): sources
+        # cap concurrent outbound streams, surplus readers get "busy"
+        # and retry against a REFRESHED directory — every completed
+        # pull registers a new source, so capacity doubles per
+        # generation instead of head-of-lineage serializing N readers.
+        pass_num = 0
+        # "busy" proves a live copy is actively streaming to someone —
+        # re-arm the deadline on it (bounded by the hard cap) so a slow
+        # early generation doesn't fail readers that WOULD be served
+        hard_cap = time.monotonic() + 10 * self.config.arg_fetch_timeout_s
+        while True:
+            pass_num += 1
+            if pass_num > 1:
+                if time.monotonic() >= min(join_deadline, hard_cap):
+                    break
+                await asyncio.sleep(
+                    random.uniform(0.2, min(0.3 * pass_num, 1.5)))
                 try:
-                    first = await remote.call("pull_object", {
-                        "object_id": oid.hex(), "offset": 0, "length": CHUNK})
-                    if not first.get("found"):
-                        continue
-                    total = first["total_size"]
-                    if self.store.contains(oid):
-                        return
-                    admitted = await self._admit_pull(total)
+                    r = await self.gcs.call(
+                        "get_object_locations",
+                        {"object_id": oid.hex()})
+                    locs = [l for l in r["locations"]
+                            if l["node_id"] != self.node_id]
+                except Exception as e:
+                    last_err = e
+                    continue
+            random.shuffle(locs)
+            saw_busy = False
+            for loc in locs:
+                try:
+                    remote = await protocol.connect(loc["raylet_address"])
                     try:
+                        first = await remote.call("pull_object", {
+                            "object_id": oid.hex(), "offset": 0, "length": CHUNK})
+                        if first.get("busy"):
+                            saw_busy = True
+                            continue
+                        if not first.get("found"):
+                            continue
+                        total = first["total_size"]
                         if self.store.contains(oid):
                             return
+                        admitted = await self._admit_pull(total)
                         try:
+                            if self.store.contains(oid):
+                                return
                             try:
-                                buf = self.store.create(oid, total)
-                            except ValueError:
-                                # slot taken but object not sealed: an
-                                # interrupted inbound push holds it —
-                                # reap and take over (a LIVE push or a
-                                # concurrent fetch re-raises → handled
-                                # by the wait loop below)
-                                if not self._abort_stale_push(
-                                        oid.hex(), max_age=10.0):
-                                    raise
-                                buf = self.store.create(oid, total)
-                        except ObjectStoreFullError:
-                            await self._spill_until(total)
-                            buf = self.store.create(oid, total,
-                                                    allow_fallback=True)
-                        try:
-                            loop_ = asyncio.get_running_loop()
+                                try:
+                                    buf = self.store.create(oid, total)
+                                except ValueError:
+                                    # slot taken but object not sealed: an
+                                    # interrupted inbound push holds it —
+                                    # reap and take over (a LIVE push or a
+                                    # concurrent fetch re-raises → handled
+                                    # by the wait loop below)
+                                    if not self._abort_stale_push(
+                                            oid.hex(), max_age=10.0):
+                                        raise
+                                    buf = self.store.create(oid, total)
+                            except ObjectStoreFullError:
+                                await self._spill_until(total)
+                                buf = self.store.create(oid, total,
+                                                        allow_fallback=True)
+                            try:
+                                loop_ = asyncio.get_running_loop()
 
-                            def _write(dst_off, d):
-                                buf[dst_off:dst_off + len(d)] = d
+                                def _write(dst_off, d):
+                                    buf[dst_off:dst_off + len(d)] = d
 
-                            data = first["data"]
-                            # chunk writes run in the executor — a GiB
-                            # of inline memcpys stalls this raylet's
-                            # loop just like inline serving stalls the
-                            # holder's (see handle_pull_object)
-                            await loop_.run_in_executor(
-                                None, _write, 0, data)
-                            got = len(data)
-                            while got < total:
-                                chunk = await remote.call("pull_object", {
-                                    "object_id": oid.hex(), "offset": got,
-                                    "length": CHUNK})
-                                d = chunk["data"]
+                                data = first["data"]
+                                # chunk writes run in the executor — a GiB
+                                # of inline memcpys stalls this raylet's
+                                # loop just like inline serving stalls the
+                                # holder's (see handle_pull_object)
                                 await loop_.run_in_executor(
-                                    None, _write, got, d)
-                                got += len(d)
-                        except BaseException:
-                            # never leak an unsealed create: it would
-                            # brick the object on this node
+                                    None, _write, 0, data)
+                                got = len(data)
+                                while got < total:
+                                    chunk = await remote.call("pull_object", {
+                                        "object_id": oid.hex(), "offset": got,
+                                        "length": CHUNK})
+                                    d = chunk["data"]
+                                    await loop_.run_in_executor(
+                                        None, _write, got, d)
+                                    got += len(d)
+                            except BaseException:
+                                # never leak an unsealed create: it would
+                                # brick the object on this node
+                                buf.release()
+                                self.store.abort(oid)
+                                raise
                             buf.release()
-                            self.store.abort(oid)
-                            raise
-                        buf.release()
-                        self.store.seal(oid)
-                    finally:
-                        await self._release_pull(admitted)
-                    await self.gcs.call("add_object_location", {
-                        "object_id": oid.hex(), "node_id": self.node_id})
-                    return
-                finally:
-                    remote.close()
-            except ValueError as e:
-                # a LIVE inbound push holds the slot (same-process
-                # fetches are deduped above): JOIN it — wait for its
-                # seal as long as chunks keep arriving (a GiB push at
-                # contended bandwidth takes minutes; a fixed short cap
-                # abandoned pushes that were making steady progress),
-                # reaping only a STALE push so the pull can take over
-                while time.monotonic() < join_deadline:
-                    if self.store.contains(oid):
+                            self.store.seal(oid)
+                        finally:
+                            await self._release_pull(admitted)
+                        await self.gcs.call("add_object_location", {
+                            "object_id": oid.hex(), "node_id": self.node_id})
                         return
-                    if self._abort_stale_push(oid.hex(), max_age=10.0):
-                        break  # interrupted push reaped — retry pull
-                    await asyncio.sleep(0.5)
-                last_err = e
-            except Exception as e:  # try next replica
-                last_err = e
+                    finally:
+                        remote.close()
+                except ValueError as e:
+                    # a LIVE inbound push holds the slot (same-process
+                    # fetches are deduped above): JOIN it — wait for its
+                    # seal as long as chunks keep arriving (a GiB push at
+                    # contended bandwidth takes minutes; a fixed short cap
+                    # abandoned pushes that were making steady progress),
+                    # reaping only a STALE push so the pull can take over
+                    while time.monotonic() < join_deadline:
+                        if self.store.contains(oid):
+                            return
+                        if self._abort_stale_push(oid.hex(), max_age=10.0):
+                            break  # interrupted push reaped — retry pull
+                        await asyncio.sleep(0.5)
+                    last_err = e
+                except Exception as e:  # try next replica
+                    last_err = e
+            if saw_busy:
+                join_deadline = max(
+                    join_deadline,
+                    time.monotonic() + self.config.arg_fetch_timeout_s)
+                last_err = last_err or RuntimeError(
+                    "all replicas at their serve cap")
+            elif pass_num >= 2:
+                # replicas genuinely failed twice (not merely busy):
+                # give up — the old two-pass semantics
+                break
         raise RuntimeError(f"could not fetch {oid}: no live copies "
                            f"({last_err})")
 

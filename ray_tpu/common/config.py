@@ -54,6 +54,11 @@ class SystemConfig:
     # how long an executing task waits for an ObjectRef argument before
     # erroring (a freed/lost arg must not wedge the executor forever)
     arg_fetch_timeout_s: float = 300.0
+    # max concurrent outbound object-pull streams a node serves for
+    # LARGE objects; the surplus gets "busy" and retries against the
+    # growing source set (tree broadcast — see raylet.handle_pull_object)
+    object_serve_concurrency: int = 3
+    object_serve_tree_min_bytes: int = 256 * 1024 * 1024
     prestart_workers: bool = True
     # ---- memory monitor / OOM protection (reference:
     # src/ray/common/memory_monitor.h + raylet/worker_killing_policy.h) ----

@@ -43,10 +43,28 @@ class AllToAllStage(Stage):
         self.extra = extra
 
 
-def _apply_chain(fns, block):
+def _apply_chain_with_stats(fns, block):
+    """Chain the fns AND return per-task stats as a second return (the
+    reference's map tasks do the same — block + BlockMetadata pair) so
+    Dataset.stats() can report per-stage wall/cpu/rows without extra
+    round trips."""
+    import os
+    import time as _t
+    acc_in = BlockAccessor.for_block(block)
+    rows_in = acc_in.num_rows()
+    wall0 = _t.perf_counter()
+    cpu0 = _t.process_time()
     for f in fns:
         block = f(block)
-    return block
+    acc_out = BlockAccessor.for_block(block)
+    return block, {
+        "wall_s": _t.perf_counter() - wall0,
+        "cpu_s": _t.process_time() - cpu0,
+        "rows_in": rows_in,
+        "rows_out": acc_out.num_rows(),
+        "bytes_out": acc_out.size_bytes(),
+        "pid": os.getpid(),
+    }
 
 
 _chain_task = None
@@ -58,33 +76,98 @@ def _get_chain_task():
     global _chain_task
     if _chain_task is None:
         import ray_tpu
-        _chain_task = ray_tpu.remote(_apply_chain)
+        _chain_task = ray_tpu.remote(_apply_chain_with_stats)
     return _chain_task
 
 
 class DatasetStats:
-    """Per-stage wall time + block counts + substage task breakdowns
-    (reference: _internal/stats.py DatasetStats)."""
+    """Per-stage wall/cpu/rows breakdowns + substage task detail
+    (reference: _internal/stats.py DatasetStats).  Map-stage task stats
+    arrive as object refs and resolve lazily at summary time."""
 
     def __init__(self):
         self.stages: List[Tuple[str, float, int,
                                 Optional[Dict[str, Any]]]] = []
 
     def record(self, name: str, seconds: float, n_blocks: int,
-               extra: Optional[Dict[str, Any]] = None):
-        self.stages.append((name, seconds, n_blocks, extra or None))
+               extra: Optional[Dict[str, Any]] = None,
+               task_stats_refs: Optional[List[Any]] = None):
+        entry = dict(extra or {})
+        if task_stats_refs:
+            entry["_task_stats_refs"] = task_stats_refs
+        self.stages.append((name, seconds, n_blocks, entry or None))
 
     def copy(self) -> "DatasetStats":
         out = DatasetStats()
         out.stages = list(self.stages)
         return out
 
+    def _resolve_tasks(self, extra) -> Optional[Dict[str, Any]]:
+        if extra and "_task_stats" in extra:
+            return extra["_task_stats"]  # resolved once, cached
+        refs = (extra or {}).get("_task_stats_refs")
+        if not refs:
+            return None
+        import ray_tpu
+        try:
+            rows = [r for r in ray_tpu.get(list(refs), timeout=60)
+                    if isinstance(r, dict)]
+        except Exception:
+            rows = []
+        if not rows:
+            extra["_task_stats"] = None  # don't re-block on lost refs
+            extra.pop("_task_stats_refs", None)
+            return None
+        resolved = {
+            "tasks": len(rows),
+            "wall_s": round(sum(r["wall_s"] for r in rows), 4),
+            "wall_max_s": round(max(r["wall_s"] for r in rows), 4),
+            "cpu_s": round(sum(r["cpu_s"] for r in rows), 4),
+            "rows_in": sum(r["rows_in"] for r in rows),
+            "rows_out": sum(r["rows_out"] for r in rows),
+            "bytes_out": sum(r["bytes_out"] for r in rows),
+            "workers": len({r["pid"] for r in rows}),
+        }
+        extra["_task_stats"] = resolved
+        extra.pop("_task_stats_refs", None)
+        return resolved
+
+    def to_dict(self) -> List[Dict[str, Any]]:
+        out = []
+        for name, secs, n, extra in self.stages:
+            row = {"stage": name, "submit_s": round(secs, 4),
+                   "blocks": n}
+            tasks = self._resolve_tasks(extra)
+            if tasks:
+                row.update(tasks)
+            if extra:
+                row.update({k: v for k, v in extra.items()
+                            if not k.startswith("_task_stats")})
+            out.append(row)
+        return out
+
     def summary_string(self) -> str:
         lines = ["Dataset stats:"]
-        for name, secs, n, extra in self.stages:
-            lines.append(f"  stage {name}: {n} blocks, {secs * 1e3:.1f}ms")
-            if extra:
-                detail = ", ".join(f"{k}={v}" for k, v in extra.items())
+        for row in self.to_dict():
+            name, n = row["stage"], row["blocks"]
+            if "wall_s" in row:
+                lines.append(
+                    f"  stage {name}: {n} blocks, "
+                    f"{row['rows_out']} rows, "
+                    f"wall {row['wall_s'] * 1e3:.1f}ms "
+                    f"(max {row['wall_max_s'] * 1e3:.1f}ms), "
+                    f"cpu {row['cpu_s'] * 1e3:.1f}ms, "
+                    f"{row['workers']} workers")
+            else:
+                lines.append(f"  stage {name}: {n} blocks, "
+                             f"{row['submit_s'] * 1e3:.1f}ms")
+            detail = ", ".join(
+                f"{k}={v}" for k, v in row.items()
+                if k not in ("stage", "blocks", "submit_s", "wall_s",
+                             "wall_max_s", "cpu_s", "rows_in",
+                             "rows_out", "bytes_out", "workers",
+                             "tasks"))
+            if detail:
                 lines.append(f"    {detail}")
         return "\n".join(lines)
 
@@ -142,12 +225,16 @@ class ExecutionPlan:
                     ActorPoolStrategy, run_on_actor_pool)
                 if isinstance(compute, ActorPoolStrategy):
                     blocks = run_on_actor_pool(compute, fns, blocks, opts)
+                    self.stats.record(name, time.time() - t0, len(blocks))
                 else:
                     task = _get_chain_task()
-                    if opts:
-                        task = task.options(**opts)
-                    blocks = [task.remote(fns, b) for b in blocks]
-                self.stats.record(name, time.time() - t0, len(blocks))
+                    opts = dict(opts, num_returns=2)
+                    task = task.options(**opts)
+                    pairs = [task.remote(fns, b) for b in blocks]
+                    blocks = [p[0] for p in pairs]
+                    self.stats.record(name, time.time() - t0, len(blocks),
+                                      task_stats_refs=[p[1]
+                                                       for p in pairs])
                 i = j
             else:
                 blocks = stage.fn(blocks)

@@ -182,13 +182,37 @@ def _key_of(row: Any, key) -> Any:
     return getattr(row, key)
 
 
+def _deep_size(obj: Any, depth: int = 3) -> int:
+    """Recursive size estimate: getsizeof is SHALLOW — a dict row of
+    512 KiB ndarrays reported ~100 bytes, making size-based splitting
+    and spill accounting blind to the real payload."""
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if depth <= 0:
+        return sys.getsizeof(obj)
+    if isinstance(obj, dict):
+        return sys.getsizeof(obj) + sum(
+            _deep_size(v, depth - 1) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        n = len(obj)
+        if not n:
+            return sys.getsizeof(obj)
+        sample = obj[:100]
+        est = sum(_deep_size(v, depth - 1) for v in sample)
+        return sys.getsizeof(obj) + int(est * (n / len(sample)))
+    return sys.getsizeof(obj)
+
+
 class SimpleBlockAccessor(BlockAccessor):
     def num_rows(self) -> int:
         return len(self._block)
 
     def size_bytes(self) -> int:
-        return sum(sys.getsizeof(r) for r in self._block[:100]) * max(
-            1, len(self._block) // max(1, min(100, len(self._block))))
+        n = len(self._block)
+        if not n:
+            return 0
+        sample = self._block[:100]
+        return int(sum(_deep_size(r) for r in sample) * (n / len(sample)))
 
     def schema(self) -> Any:
         return type(self._block[0]).__name__ if self._block else None

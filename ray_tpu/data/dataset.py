@@ -140,6 +140,42 @@ class Dataset:
         return Dataset(self._plan.with_stage(
             AllToAllStage("repartition", _do)), self._epoch)
 
+    def repartition_by_size(self, target_bytes: int) -> "Dataset":
+        """Split oversized blocks so none exceeds ``target_bytes`` —
+        the block-size-based splitting the reference applies dynamically
+        in its map tasks (reference: _internal/plan -> block splitting
+        on target_max_block_size).  Splits run remotely per block; no
+        driver materialization."""
+        if target_bytes <= 0:
+            raise ValueError("target_bytes must be positive")
+
+        def _do(refs):
+            import ray_tpu
+            metas = get_metadata(refs)
+
+            def _split(block, parts):
+                acc = BlockAccessor.for_block(block)
+                n = acc.num_rows()
+                cuts = [round(i * n / parts) for i in range(parts + 1)]
+                return [acc.slice(cuts[i], cuts[i + 1])
+                        for i in range(parts)]
+
+            out = []
+            for ref, m in zip(refs, metas):
+                parts = -(-max(m.size_bytes, 1) // target_bytes)
+                if parts <= 1 or m.num_rows <= 1:
+                    out.append(ref)
+                    continue
+                parts = min(parts, m.num_rows)
+                pieces = ray_tpu.remote(_split).options(
+                    num_returns=parts).remote(ref, parts)
+                out.extend(pieces if isinstance(pieces, list)
+                           else [pieces])
+            return out
+
+        return Dataset(self._plan.with_stage(
+            AllToAllStage("repartition_by_size", _do)), self._epoch)
+
     def randomize_block_order(self, *, seed: Optional[int] = None
                               ) -> "Dataset":
         def _do(refs):

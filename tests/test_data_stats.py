@@ -1,0 +1,59 @@
+"""Per-stage DatasetStats + size-based block splitting (reference:
+data/_internal/stats.py DatasetStats; block splitting on
+target_max_block_size in the reference's map tasks)."""
+
+import numpy as np
+
+import ray_tpu
+import ray_tpu.data as rdata
+
+
+def test_stats_report_wall_cpu_rows(ray_start_shared):
+    ds = rdata.from_items([{"v": float(i)} for i in range(100)],
+                          parallelism=4)
+    out = ds.map_batches(lambda b: {"v": np.asarray(b["v"]) * 2}) \
+            .filter(lambda r: r["v"] >= 10.0)
+    out.take_all()
+    rows = out._plan.stats.to_dict()
+    map_rows = [r for r in rows if "map_batches" in r["stage"]]
+    assert map_rows, rows
+    st = map_rows[0]
+    # per-task wall/cpu/rows aggregated across blocks
+    assert st["tasks"] == 4
+    assert st["rows_in"] == 100
+    assert st["rows_out"] == 95  # filter fused into the same stage
+    assert st["wall_s"] >= 0 and st["cpu_s"] >= 0
+    assert st["workers"] >= 1
+    s = out.stats()
+    assert "rows" in s and "wall" in s and "cpu" in s
+
+
+def test_stats_all_to_all_stage_recorded(ray_start_shared):
+    ds = rdata.from_items([{"v": i} for i in range(50)], parallelism=5)
+    out = ds.repartition(2)
+    out.take_all()
+    names = [r["stage"] for r in out._plan.stats.to_dict()]
+    assert "repartition" in names
+
+
+def test_repartition_by_size_splits_oversized_blocks(ray_start_shared):
+    # 2 blocks x ~4 MB each; target 1 MB -> every output block under it
+    ds = rdata.from_items(
+        [{"x": np.zeros(512 * 1024, np.uint8)} for _ in range(16)],
+        parallelism=2)
+    out = ds.repartition_by_size(1024 * 1024)
+    metas = out._meta()
+    assert len(metas) > 2
+    assert all(m.size_bytes <= 1100 * 1024 for m in metas)
+    assert sum(m.num_rows for m in metas) == 16
+    # rows survive intact
+    rows = out.take_all()
+    assert len(rows) == 16 and all(r["x"].nbytes == 512 * 1024
+                                   for r in rows)
+
+
+def test_repartition_by_size_keeps_small_blocks(ray_start_shared):
+    ds = rdata.from_items([{"v": i} for i in range(10)], parallelism=2)
+    out = ds.repartition_by_size(64 * 1024 * 1024)
+    assert len(out._blocks()) == 2  # untouched
+    assert sorted(r["v"] for r in out.take_all()) == list(range(10))
