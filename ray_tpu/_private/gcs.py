@@ -116,6 +116,7 @@ class GcsServer:
             "node_liveness": self.node_liveness,
             "get_nodes": self.get_nodes,
             "profile_stacks": self.profile_stacks,
+            "profile_flamegraph": self.profile_flamegraph,
             "get_node_stats": self.get_node_stats,
             "drain_node": self.drain_node,
             "kv_put": self.kv_put,
@@ -433,6 +434,20 @@ class GcsServer:
             "dump_worker_stacks",
             {"worker_id": payload.get("worker_id")},
             node_id=payload.get("node_id"))
+
+    async def profile_flamegraph(self, payload, conn):
+        """Timed sampling profiles (folded stacks) of workers across
+        the cluster (reference: profile_manager.py py-spy flamegraphs).
+        node_id/worker_id narrow the fan-out."""
+        duration = min(float(payload.get("duration_s") or 2.0), 30.0)
+        req = {"duration_s": duration}
+        for k in ("worker_id", "interval_s"):
+            if payload.get(k) is not None:
+                req[k] = payload[k]
+        return await self._fanout_to_raylets(
+            "profile_workers", req,
+            node_id=payload.get("node_id"),
+            timeout=duration + 15.0)
 
     async def get_node_stats(self, payload, conn):
         """Fan a node-stats snapshot out to raylet agents (reference:

@@ -353,6 +353,7 @@ class Raylet:
             "get_info": self.handle_get_info,
             "node_stats": self.handle_node_stats,
             "dump_worker_stacks": self.handle_dump_worker_stacks,
+            "profile_workers": self.handle_profile_workers,
             "cancel_task": self.handle_cancel_task,
             "lease_worker": self.handle_lease_worker,
             "release_lease": self.handle_release_lease,
@@ -1126,6 +1127,32 @@ class Raylet:
             self._push_idle(handle)
         self._dispatch_event.set()
         return {}
+
+    async def handle_profile_workers(self, payload, conn):
+        """Timed sampling profiles of this node's workers -> folded
+        stacks (reference: profile_manager.py). worker_id narrows to
+        one; profiles of several workers run concurrently."""
+        want = payload.get("worker_id")
+        duration = min(float(payload.get("duration_s") or 2.0), 30.0)
+        targets = [(wid, h) for wid, h in list(self.workers.items())
+                   if h.conn is not None and (not want or wid == want)]
+
+        req = {"duration_s": duration}
+        if payload.get("interval_s") is not None:
+            req["interval_s"] = payload["interval_s"]
+
+        async def _one(wid, handle):
+            try:
+                return await asyncio.wait_for(
+                    handle.conn.call("profile_worker", dict(req)),
+                    timeout=duration + 10)
+            except Exception as e:
+                return {"worker_id": wid,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        out = list(await asyncio.gather(
+            *[_one(wid, h) for wid, h in targets])) if targets else []
+        return {"node_id": self.node_id, "workers": out}
 
     async def handle_dump_worker_stacks(self, payload, conn):
         """On-demand live stack snapshot of this node's workers

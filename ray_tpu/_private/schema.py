@@ -173,6 +173,11 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "dump_stacks": {},
     "node_stats": {},
     "dump_worker_stacks": {"worker_id": (_str, False)},
+    "profile_worker": {"duration_s": (_num, False),
+                       "interval_s": (_num, False)},
+    "profile_workers": {"worker_id": (_str, False),
+                        "duration_s": (_num, False),
+                        "interval_s": (_num, False)},
 }
 
 

@@ -563,6 +563,7 @@ class Worker:
             "ping": self._h_ping,
             "pubsub": self._h_pubsub,
             "dump_stacks": self._h_dump_stacks,
+            "profile_worker": self._h_profile_worker,
         }
 
     async def _h_dump_stacks(self, payload, conn):
@@ -588,6 +589,52 @@ class Worker:
                 "actor_seq": dict(self._actor_seq),
                 "parked_seqs": {c: sorted(m) for c, m in
                                 self._actor_waiting.items() if m}}
+
+    async def _h_profile_worker(self, payload, conn):
+        """Timed SAMPLING profile of this process -> folded stacks
+        (flamegraph-collapsed format, speedscope-importable).
+        Reference: dashboard/modules/reporter/profile_manager.py (py-spy
+        there; a sys._current_frames sampler here — no external tools).
+        The sampler runs on an executor thread so the io loop keeps
+        serving while the profile is taken."""
+        duration = min(float(payload.get("duration_s") or 2.0), 30.0)
+        interval = max(0.001, float(payload.get("interval_s") or 0.01))
+
+        def _sample():
+            import collections
+            folded: collections.Counter = collections.Counter()
+            me = threading.get_ident()
+            end = time.monotonic() + duration
+            n = 0
+            while time.monotonic() < end:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_name}@"
+                            f"{os.path.basename(code.co_filename)}:"
+                            f"{f.f_lineno}")
+                        f = f.f_back
+                    folded[";".join(reversed(stack))] += 1
+                n += 1
+                time.sleep(interval)
+            return folded, n
+
+        folded, n = await asyncio.get_running_loop().run_in_executor(
+            None, _sample)
+        # report the RAYLET-REGISTRY worker id (the one
+        # profile_flamegraph(worker_id=...) filters by), not the
+        # process's random uid
+        return {"pid": os.getpid(),
+                "worker_id": os.environ.get("RTPU_WORKER_ID")
+                or self.worker_id.hex(),
+                "samples": n, "duration_s": duration,
+                "folded": "\n".join(f"{k} {v}"
+                                    for k, v in folded.most_common())}
 
     async def _h_pubsub(self, payload, conn):
         """GCS pubsub push. Drivers mirror 'worker_logs' lines to their own

@@ -125,6 +125,16 @@ class DashboardActor:
                     return self._json(200, state.profile_stacks(
                         node_id=(q.get("node_id") or [None])[0],
                         worker_id=(q.get("worker_id") or [None])[0]))
+                if path == "/api/profile/flamegraph":
+                    # timed sampling -> folded stacks (reference:
+                    # reporter/profile_manager.py py-spy flamegraphs)
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    return self._json(200, state.profile_flamegraph(
+                        node_id=(q.get("node_id") or [None])[0],
+                        worker_id=(q.get("worker_id") or [None])[0],
+                        duration_s=float(
+                            (q.get("duration_s") or ["2.0"])[0])))
                 if path == "/api/events":
                     from urllib.parse import parse_qs, urlparse
                     q = parse_qs(urlparse(self.path).query)

@@ -160,6 +160,7 @@ class Dataset:
                 return [acc.slice(cuts[i], cuts[i + 1])
                         for i in range(parts)]
 
+            split_task = ray_tpu.remote(_split)  # ONE export for all blocks
             out = []
             for ref, m in zip(refs, metas):
                 parts = -(-max(m.size_bytes, 1) // target_bytes)
@@ -167,7 +168,7 @@ class Dataset:
                     out.append(ref)
                     continue
                 parts = min(parts, m.num_rows)
-                pieces = ray_tpu.remote(_split).options(
+                pieces = split_task.options(
                     num_returns=parts).remote(ref, parts)
                 out.extend(pieces if isinstance(pieces, list)
                            else [pieces])

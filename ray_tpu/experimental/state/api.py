@@ -12,11 +12,12 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private import worker as _worker_mod
 
 
-def _gcs_call(method: str, payload: Optional[dict] = None) -> dict:
+def _gcs_call(method: str, payload: Optional[dict] = None,
+              timeout: float = 30) -> dict:
     w = _worker_mod._global_worker
     if w is None or not w.connected:
         raise RuntimeError("ray_tpu is not initialized")
-    return w.call_sync(w.gcs, method, payload or {}, timeout=30)
+    return w.call_sync(w.gcs, method, payload or {}, timeout=timeout)
 
 
 def list_nodes(filters: Optional[Dict[str, Any]] = None
@@ -36,6 +37,23 @@ def profile_stacks(node_id: Optional[str] = None,
     profiling; faulthandler-style dumps here)."""
     return _gcs_call("profile_stacks",
                      {"node_id": node_id, "worker_id": worker_id})
+
+
+def profile_flamegraph(node_id: Optional[str] = None,
+                       worker_id: Optional[str] = None,
+                       duration_s: float = 2.0,
+                       interval_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+    """Timed sampling profile of workers -> folded stacks (the
+    flamegraph-collapsed format flamegraph.pl and speedscope import;
+    reference: profile_manager.py py-spy flamegraphs)."""
+    return _gcs_call("profile_flamegraph",
+                     {"node_id": node_id, "worker_id": worker_id,
+                      "duration_s": duration_s,
+                      "interval_s": interval_s},
+                     # the whole GCS->raylet->worker chain runs for
+                     # duration_s before replying
+                     timeout=min(float(duration_s), 30.0) + 25)
 
 
 def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -117,6 +117,53 @@ exec "$@"
         ray_tpu.shutdown()
 
 
+def test_conda_worker_end_to_end(tmp_path):
+    """A fake conda that materializes envs whose bin/python symlinks the
+    real interpreter proves the full spawn path: env creation happens
+    ONCE (cache), the worker launches through the env's python, and
+    same-env tasks reuse the pooled worker."""
+    import sys
+
+    calls = tmp_path / "create_calls"
+    fake = tmp_path / "conda"
+    # the fake env's bin/python is an exec WRAPPER around the real
+    # interpreter (a symlink would lose the venv's pyvenv.cfg context)
+    # that stamps the env dir into the worker's environment
+    fake.write_text(f"""#!/bin/sh
+case "$1" in
+  info) echo {tmp_path}/conda_base ;;
+  env)  echo created >> {calls}
+        mkdir -p "$4/bin"
+        printf '#!/bin/sh\\nexport RTPU_FAKE_CONDA_ENV="%s"\\nexec {sys.executable} "$@"\\n' "$4" > "$4/bin/python"
+        chmod +x "$4/bin/python" ;;
+esac
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    os.environ["CONDA_EXE"] = str(fake)
+    try:
+        ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                     object_store_memory=64 * 1024 * 1024)
+        # unique spec per run: the conda cache is content-addressed and
+        # host-wide, so a fixed spec would reuse an env materialized by
+        # a PREVIOUS test run's fake
+        import uuid
+        spec = {"dependencies": [f"python=3  # {uuid.uuid4().hex}"]}
+
+        @ray_tpu.remote(runtime_env={"conda": spec})
+        def probe():
+            return os.environ.get("RTPU_FAKE_CONDA_ENV"), os.getpid()
+
+        env1, pid1 = ray_tpu.get(probe.remote(), timeout=120)
+        assert env1 and "/conda/" in env1  # launched through the env
+        # same env -> pooled worker reused, no second env create
+        env2, pid2 = ray_tpu.get(probe.remote(), timeout=120)
+        assert env2 == env1 and pid2 == pid1
+        assert calls.read_text().count("created") == 1
+    finally:
+        os.environ.pop("CONDA_EXE", None)
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------- ingress
 
 def test_api_router_dispatch_unit():

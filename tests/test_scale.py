@@ -25,9 +25,15 @@ from ray_tpu._private import cluster_utils
 
 FULL = bool(os.environ.get("RTPU_SCALE_FULL"))
 
-N_NODES = 50 if FULL else 20
+N_NODES = 100 if FULL else 20
+# each simulated raylet advertises CPUS_PER_NODE logical CPUs: resource
+# accounting is what the PG envelope exercises (committed bundles
+# holding capacity), and the reference bar of 1k+ SIMULTANEOUSLY
+# RUNNING placement groups needs >=1k CPUs of logical capacity — its
+# own numbers come from 64x64-core hosts
+CPUS_PER_NODE = 12 if FULL else 1
 N_TASKS = 10_000 if FULL else 3_000
-N_PGS = 500 if FULL else 120
+N_PGS = 1_200 if FULL else 120
 BCAST_MB = 1024 if FULL else 128
 BCAST_NODES = 20 if FULL else 8
 SUBMIT_N = 30_000 if FULL else 20_000
@@ -41,7 +47,8 @@ def scale_cluster():
     node_store = (1536 if FULL else 192) * 1024 * 1024
     c = cluster_utils.Cluster(head_node_args={
         "num_cpus": 4, "object_store_memory": head_store})
-    c.add_nodes(N_NODES, num_cpus=1, object_store_memory=node_store)
+    c.add_nodes(N_NODES, num_cpus=CPUS_PER_NODE,
+                object_store_memory=node_store)
     c.connect()
     c.wait_for_nodes(timeout=180)
     yield c
@@ -105,7 +112,9 @@ def test_many_placement_groups(scale_cluster):
     from ray_tpu.util.placement_group import (
         placement_group, remove_placement_group)
     created = []
-    capacity = N_NODES + 4  # total cluster CPUs; ready PGs plateau here
+    # total cluster CPUs; ready PGs plateau here (FULL: 1,204 -> the
+    # reference's "1k+ simultaneously running placement groups" bar)
+    capacity = N_NODES * CPUS_PER_NODE + 4
     ready = 0
     try:
         t0 = time.perf_counter()

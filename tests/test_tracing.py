@@ -111,3 +111,39 @@ def test_profile_stacks_http_route(cluster):
             timeout=30) as resp:
         doc = json.loads(resp.read())
     assert "nodes" in doc
+
+
+def test_flamegraph_of_busy_worker(cluster):
+    """Timed sampling profile -> folded stacks: the busy function's
+    frame dominates the samples (reference:
+    reporter/profile_manager.py py-spy flamegraphs)."""
+    import json
+    import urllib.request
+    from ray_tpu.dashboard.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def fg_spin(sec):
+        import time as _t
+        end = _t.monotonic() + sec
+        acc = 0
+        while _t.monotonic() < end:  # CPU-busy, stays on the stack
+            acc += 1
+        return acc
+
+    ref = fg_spin.remote(6.0)
+    time.sleep(1.0)  # let it dispatch
+    port = start_dashboard(port=18272)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/profile/flamegraph"
+            f"?duration_s=1.5", timeout=60) as resp:
+        doc = json.loads(resp.read())
+    profiles = [w for n in doc["nodes"] for w in n.get("workers", [])
+                if w.get("folded")]
+    assert profiles, doc
+    joined = "\n".join(p["folded"] for p in profiles)
+    assert "fg_spin" in joined, joined[:1500]
+    # folded format: "frame;frame;... count" — flamegraph.pl-parseable
+    line = next(ln for ln in joined.splitlines() if "fg_spin" in ln)
+    assert line.rsplit(" ", 1)[1].isdigit()
+    assert all(p["samples"] > 0 for p in profiles)
+    assert ray_tpu.get(ref, timeout=60) > 0
