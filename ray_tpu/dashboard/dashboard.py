@@ -125,6 +125,10 @@ class DashboardActor:
                     return self._json(200, state.profile_stacks(
                         node_id=(q.get("node_id") or [None])[0],
                         worker_id=(q.get("worker_id") or [None])[0]))
+                if path == "/api/grafana/dashboards":
+                    from ray_tpu.dashboard.grafana import (
+                        generate_dashboards)
+                    return self._json(200, generate_dashboards())
                 if path == "/api/profile/flamegraph":
                     # timed sampling -> folded stacks (reference:
                     # reporter/profile_manager.py py-spy flamegraphs)

@@ -18,6 +18,8 @@ from ray_tpu.rllib.connectors import (ClipActionConnector, Connector,
                                       LambdaConnector, MeanStdObsConnector)
 from ray_tpu.rllib.models import MLPNet, AtariCNN, make_model
 from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.core import (Learner, LearnerGroup, MultiRLModule,
+                                PPOLearner, RLModule, RLModuleSpec)
 from ray_tpu.rllib.postprocessing import compute_advantages
 from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
                                           ReplayBuffer)
@@ -40,4 +42,6 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentCartPole",
     "Connector", "ConnectorPipeline", "FlattenObsConnector",
     "MeanStdObsConnector", "ClipActionConnector", "LambdaConnector",
+    "RLModule", "RLModuleSpec", "MultiRLModule", "Learner",
+    "PPOLearner", "LearnerGroup",
 ]

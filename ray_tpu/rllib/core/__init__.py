@@ -1,0 +1,11 @@
+"""ray_tpu.rllib.core — the next-generation RLModule/Learner stack
+(reference: rllib/core/)."""
+
+from ray_tpu.rllib.core.learner import (DEFAULT_MODULE_ID, Learner,
+                                        PPOLearner)
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import (MultiRLModule, RLModule,
+                                          RLModuleSpec)
+
+__all__ = ["RLModule", "RLModuleSpec", "MultiRLModule", "Learner",
+           "PPOLearner", "LearnerGroup", "DEFAULT_MODULE_ID"]

@@ -1,0 +1,204 @@
+"""Learner — the next-generation training abstraction.
+
+Reference analogue: rllib/core/learner/learner.py (Learner:139,
+compute_loss_for_module, update_from_batch) — the training half of the
+RLModule/Learner split: the Learner owns optimizers and losses over one
+MultiRLModule; algorithms subclass only `compute_loss_for_module`.
+
+TPU-first: per-module (loss -> grad -> optax update) is ONE jitted
+program with donated optimizer state; multi-module updates run each
+module's compiled program in sequence (fixed shapes, zero retraces
+after warmup).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import (MultiRLModule, RLModule,
+                                          RLModuleSpec)
+
+DEFAULT_MODULE_ID = "default_policy"
+
+
+class Learner:
+    """Owns a MultiRLModule + one optimizer per module; subclasses
+    override :meth:`compute_loss_for_module`."""
+
+    def __init__(self, *, module_spec: Optional[RLModuleSpec] = None,
+                 module_specs: Optional[Dict[str, RLModuleSpec]] = None,
+                 config: Optional[Dict[str, Any]] = None):
+        if (module_spec is None) == (module_specs is None):
+            raise ValueError(
+                "provide exactly one of module_spec / module_specs")
+        if module_spec is not None:
+            module_specs = {DEFAULT_MODULE_ID: module_spec}
+        self.config = dict(config or {})
+        self.module = MultiRLModule(module_specs)
+        self._opt: Dict[str, Any] = {}
+        self._opt_state: Dict[str, Any] = {}
+        self._jit_update: Dict[str, Callable] = {}
+        self._jit_grads: Dict[str, Callable] = {}
+        for mid, mod in self.module.items():
+            tx = self.configure_optimizer_for_module(mid)
+            self._opt[mid] = tx
+            self._opt_state[mid] = tx.init(mod.params)
+            self._jit_update[mid] = jax.jit(
+                self._make_update(mid), donate_argnums=(0, 1))
+            self._jit_grads[mid] = jax.jit(self._make_grads(mid))
+
+    # ---- override points (reference method names) ----
+
+    def configure_optimizer_for_module(self, module_id: str):
+        lr = self.config.get("lr", 5e-4)
+        clip = self.config.get("grad_clip")
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(lr))
+        return optax.chain(*chain)
+
+    def compute_loss_for_module(self, module_id: str, module: RLModule,
+                                params, batch: Dict[str, jnp.ndarray]):
+        """Return (loss, stats_dict). Differentiated wrt params."""
+        raise NotImplementedError
+
+    # ---- update machinery ----
+
+    def _make_update(self, module_id: str):
+        module = self.module[module_id]
+        tx = self._opt[module_id]
+
+        def _update(params, opt_state, batch):
+            def loss_fn(p):
+                return self.compute_loss_for_module(
+                    module_id, module, p, batch)
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["total_loss"] = loss
+            stats["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, stats
+
+        return _update
+
+    def _make_grads(self, module_id: str):
+        module = self.module[module_id]
+
+        def _grads(params, batch):
+            def loss_fn(p):
+                return self.compute_loss_for_module(
+                    module_id, module, p, batch)
+
+            (_, _), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return grads
+
+        return _grads
+
+    def _route_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        if any(mid in batch for mid in self.module.keys()):
+            return batch
+        if DEFAULT_MODULE_ID not in self.module:
+            raise ValueError(
+                "plain column batch given to a multi-module Learner "
+                f"(modules: {sorted(self.module.keys())}); pass "
+                "{module_id: batch} so updates route explicitly")
+        return {DEFAULT_MODULE_ID: batch}
+
+    def update_from_batch(self, batch: Dict[str, Any]
+                          ) -> Dict[str, Dict[str, float]]:
+        """One SGD step.  ``batch`` is either a column dict (single
+        module) or {module_id: column dict} (reference:
+        update_from_batch / MultiAgentBatch routing)."""
+        batch = self._route_batch(batch)
+        results = {}
+        for mid, b in batch.items():
+            if mid not in self.module:
+                continue
+            module = self.module[mid]
+            jb = {k: jnp.asarray(v) for k, v in b.items()}
+            module.params, self._opt_state[mid], stats = \
+                self._jit_update[mid](module.params,
+                                      self._opt_state[mid], jb)
+            results[mid] = {k: float(v) for k, v in stats.items()
+                            if getattr(v, "ndim", 0) == 0}
+        return results
+
+    # ---- gradient-exchange hooks for LearnerGroup ----
+
+    def compute_gradients(self, batch: Dict[str, Any]
+                          ) -> Dict[str, Any]:
+        """Per-module grads as host pytrees (data-parallel learners
+        average these; reference: Learner.compute_gradients)."""
+        batch = self._route_batch(batch)
+        out = {}
+        for mid, b in batch.items():
+            if mid not in self.module:
+                continue
+            module = self.module[mid]
+            jb = {k: jnp.asarray(v) for k, v in b.items()}
+            grads = self._jit_grads[mid](module.params, jb)
+            out[mid] = jax.tree.map(np.asarray, grads)
+        return out
+
+    def apply_gradients(self, grads: Dict[str, Any]):
+        for mid, g in grads.items():
+            module = self.module[mid]
+            tx = self._opt[mid]
+            g = jax.tree.map(jnp.asarray, g)
+            updates, self._opt_state[mid] = tx.update(
+                g, self._opt_state[mid], module.params)
+            module.params = optax.apply_updates(module.params, updates)
+
+    # ---- state ----
+
+    def get_state(self) -> Dict[str, Any]:
+        # optimizer state included: a restore that resets Adam moments
+        # silently changes learning dynamics (reference Learner
+        # persists optimizers too)
+        return {"module": self.module.get_state(),
+                "optimizer": {mid: jax.tree.map(np.asarray, st)
+                              for mid, st in self._opt_state.items()}}
+
+    def set_state(self, state: Dict[str, Any]):
+        self.module.set_state(state["module"])
+        for mid, st in (state.get("optimizer") or {}).items():
+            if mid in self._opt_state:
+                self._opt_state[mid] = jax.tree.map(
+                    jnp.asarray, st)
+
+
+class PPOLearner(Learner):
+    """Clipped-surrogate PPO loss on the new stack (reference:
+    rllib/algorithms/ppo/ppo_learner.py + torch ppo_torch_learner) —
+    the canonical example algorithm of the RLModule/Learner API."""
+
+    def compute_loss_for_module(self, module_id, module, params, batch):
+        cfg = self.config
+        clip = cfg.get("clip_param", 0.2)
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.0)
+        out = module._forward_train(params, batch["obs"])
+        dist_inputs = out["action_dist_inputs"]
+        vf = out["vf_preds"]
+        logp = module.logp(dist_inputs, batch["actions"])
+        ratio = jnp.exp(logp - batch["action_logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = jnp.mean((vf - batch["value_targets"]) ** 2)
+        entropy = jnp.mean(module.entropy(dist_inputs))
+        loss = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                      "entropy": entropy}

@@ -1,0 +1,116 @@
+"""LearnerGroup — data-parallel learners.
+
+Reference analogue: rllib/core/learner/learner_group.py — N learner
+workers update one logical module set in data parallel.  The reference
+rides torch DDP/NCCL; here the TPU-first story is: MULTI-CHIP data
+parallelism belongs INSIDE one jitted program on a jax Mesh (see
+train/spmd.py — that is how a pod trains), so the multi-WORKER group
+exists for the reference-parity topology: learner actors on separate
+hosts/processes, gradients averaged through the object store
+(star reduce), every learner applying the same averaged update so
+replicas stay bit-identical.
+
+local mode (num_learners=0) runs the learner inline — the default for
+single-host training and for tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.learner import Learner
+
+
+def _avg_pytrees(trees: List[Any]):
+    import jax
+    n = len(trees)
+    return jax.tree.map(lambda *xs: sum(np.asarray(x) for x in xs) / n,
+                        *trees)
+
+
+class LearnerGroup:
+    def __init__(self, learner_cls, *, num_learners: int = 0,
+                 learner_kwargs: Optional[Dict[str, Any]] = None):
+        self._kwargs = dict(learner_kwargs or {})
+        self._local: Optional[Learner] = None
+        self._workers = []
+        if num_learners <= 0:
+            self._local = learner_cls(**self._kwargs)
+        else:
+            remote_cls = ray_tpu.remote(learner_cls)
+            self._workers = [remote_cls.remote(**self._kwargs)
+                             for _ in range(num_learners)]
+            # identical init: broadcast learner 0's weights
+            state = ray_tpu.get(self._workers[0].get_state.remote())
+            ray_tpu.get([w.set_state.remote(state)
+                         for w in self._workers[1:]])
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def update_from_batch(self, batch: Dict[str, Any]
+                          ) -> Dict[str, Dict[str, float]]:
+        """One synchronized step: local -> direct; distributed -> shard
+        the batch, average gradients (star reduce through the object
+        store), apply the same averaged update on every learner."""
+        if self._local is not None:
+            return self._local.update_from_batch(batch)
+        shards = self._shard(batch, len(self._workers))
+        # a zero-row shard would produce NaN grads (mean over an empty
+        # axis) and poison the average on EVERY replica — small final
+        # batches just use fewer learners for the step
+        pairs = [(w, s) for w, s in zip(self._workers, shards)
+                 if self._shard_rows(s) > 0]
+        grad_refs = [w.compute_gradients.remote(s) for w, s in pairs]
+        grads = ray_tpu.get(grad_refs)
+        avg = {mid: _avg_pytrees([g[mid] for g in grads])
+               for mid in grads[0]}
+        ray_tpu.get([w.apply_gradients.remote(avg)
+                     for w in self._workers])
+        return {mid: {"workers": float(len(pairs))} for mid in avg}
+
+    @staticmethod
+    def _shard_rows(shard: Dict[str, Any]) -> int:
+        first = next(iter(shard.values()))
+        if isinstance(first, dict):
+            return min((len(next(iter(cols.values())))
+                        for cols in shard.values()), default=0)
+        return len(first)
+
+    @staticmethod
+    def _shard(batch: Dict[str, Any], n: int) -> List[Dict[str, Any]]:
+        def split_cols(cols):
+            length = len(next(iter(cols.values())))
+            cuts = [round(i * length / n) for i in range(n + 1)]
+            return [{k: np.asarray(v)[cuts[i]:cuts[i + 1]]
+                     for k, v in cols.items()} for i in range(n)]
+
+        first = next(iter(batch.values()))
+        if isinstance(first, dict):  # multi-module batch
+            per_mid = {mid: split_cols(cols) for mid, cols in batch.items()}
+            return [{mid: per_mid[mid][i] for mid in batch}
+                    for i in range(n)]
+        return split_cols(batch)
+
+    def get_state(self) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.get_state()
+        return ray_tpu.get(self._workers[0].get_state.remote())
+
+    def set_state(self, state: Dict[str, Any]):
+        if self._local is not None:
+            self._local.set_state(state)
+            return
+        ray_tpu.get([w.set_state.remote(state) for w in self._workers])
+
+    def shutdown(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []

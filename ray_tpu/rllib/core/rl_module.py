@@ -1,0 +1,169 @@
+"""RLModule — the next-generation model abstraction.
+
+Reference analogue: rllib/core/rl_module/rl_module.py (RLModule:120,
+RLModuleSpec) and multi_rl_module.py — the reference's forward-looking
+API that separates the NETWORK (RLModule: three forward passes, no
+optimizer) from the TRAINING LOOP (Learner: losses + optimizers, see
+learner.py).  TPU-first differences by design:
+
+- a module is a flax model + an explicit params pytree; the three
+  forwards are jitted batched programs (vector-env-wide, no per-env
+  Python), and params stay device pytrees until a weights sync pulls
+  them to host numpy;
+- specs are plain dataclasses: `build()` is deterministic from
+  (spaces, model_config, seed) so learner workers can construct
+  identical modules without pickling live modules across processes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env import Discrete
+from ray_tpu.rllib.models import (categorical_entropy, categorical_logp,
+                                  categorical_sample,
+                                  diag_gaussian_entropy, diag_gaussian_logp,
+                                  diag_gaussian_sample, make_model)
+
+
+class RLModule:
+    """Network container with the reference's three forward passes
+    (reference: rl_module.py forward_inference:542 /
+    forward_exploration:528 / forward_train:556)."""
+
+    def __init__(self, observation_space, action_space,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 seed: int = 0):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = dict(model_config or {})
+        self.discrete = isinstance(action_space, Discrete)
+        self.model = make_model(observation_space, action_space,
+                                self.model_config or None)
+        rng = jax.random.PRNGKey(seed)
+        obs_dim = observation_space.shape or (1,)
+        dummy = jnp.zeros((1, *obs_dim), jnp.float32)
+        self.params = self.model.init(rng, dummy)["params"]
+        self._rng = jax.random.fold_in(rng, 1)
+        self._jit_inference = jax.jit(self._forward_inference)
+        self._jit_exploration = jax.jit(self._forward_exploration)
+        self._jit_train = jax.jit(self._forward_train)
+
+    # ---- the three forwards (pure; params passed explicitly so the
+    # Learner can differentiate through forward_train) ----
+
+    def _forward_inference(self, params, obs):
+        dist_inputs, vf = self.model.apply({"params": params}, obs)
+        if self.discrete:
+            actions = jnp.argmax(dist_inputs, axis=-1)
+        else:
+            actions, _ = jnp.split(dist_inputs, 2, axis=-1)
+        return {"actions": actions, "action_dist_inputs": dist_inputs,
+                "vf_preds": vf}
+
+    def _forward_exploration(self, params, obs, rng):
+        dist_inputs, vf = self.model.apply({"params": params}, obs)
+        if self.discrete:
+            actions = categorical_sample(rng, dist_inputs)
+            logp = categorical_logp(dist_inputs, actions)
+        else:
+            actions = diag_gaussian_sample(rng, dist_inputs)
+            logp = diag_gaussian_logp(dist_inputs, actions)
+        return {"actions": actions, "action_logp": logp,
+                "action_dist_inputs": dist_inputs, "vf_preds": vf}
+
+    def _forward_train(self, params, obs):
+        dist_inputs, vf = self.model.apply({"params": params}, obs)
+        return {"action_dist_inputs": dist_inputs, "vf_preds": vf}
+
+    # ---- public API (host-facing; reference method names) ----
+
+    def forward_inference(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, np.ndarray]:
+        out = self._jit_inference(self.params,
+                                  jnp.asarray(batch["obs"], jnp.float32))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def forward_exploration(self, batch: Dict[str, np.ndarray]
+                            ) -> Dict[str, np.ndarray]:
+        self._rng, sub = jax.random.split(self._rng)
+        out = self._jit_exploration(
+            self.params, jnp.asarray(batch["obs"], jnp.float32), sub)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def forward_train(self, batch: Dict[str, np.ndarray]
+                      ) -> Dict[str, np.ndarray]:
+        out = self._jit_train(self.params,
+                              jnp.asarray(batch["obs"], jnp.float32))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    # ---- distribution helpers the Learner's losses use ----
+
+    def logp(self, dist_inputs, actions):
+        if self.discrete:
+            return categorical_logp(dist_inputs, actions)
+        return diag_gaussian_logp(dist_inputs, actions)
+
+    def entropy(self, dist_inputs):
+        if self.discrete:
+            return categorical_entropy(dist_inputs)
+        return diag_gaussian_entropy(dist_inputs)
+
+    # ---- weights ----
+
+    def get_state(self) -> Dict[str, Any]:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_state(self, state: Dict[str, Any]):
+        self.params = jax.tree.map(jnp.asarray, state)
+
+
+@dataclass
+class RLModuleSpec:
+    """Deterministic module recipe (reference:
+    rl_module.py RLModuleSpec) — build() on any worker yields an
+    identical module."""
+
+    observation_space: Any = None
+    action_space: Any = None
+    model_config: Dict[str, Any] = field(default_factory=dict)
+    module_class: type = RLModule
+    seed: int = 0
+
+    def build(self) -> RLModule:
+        return self.module_class(self.observation_space,
+                                 self.action_space,
+                                 self.model_config, seed=self.seed)
+
+
+class MultiRLModule:
+    """Dict of RLModules by module id (reference:
+    multi_rl_module.py MultiRLModule) — the multi-agent container the
+    Learner iterates for per-module losses."""
+
+    def __init__(self, specs: Dict[str, RLModuleSpec]):
+        self._modules = {mid: spec.build() for mid, spec in specs.items()}
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self._modules
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def get_state(self) -> Dict[str, Any]:
+        return {mid: m.get_state() for mid, m in self._modules.items()}
+
+    def set_state(self, state: Dict[str, Any]):
+        for mid, st in state.items():
+            self._modules[mid].set_state(st)

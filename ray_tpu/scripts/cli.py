@@ -161,6 +161,12 @@ def cmd_events(args):
               f"{e.get('message', '')}")
 
 
+def cmd_grafana(args):
+    from ray_tpu.dashboard.grafana import write_dashboards
+    for path in write_dashboards(args.out):
+        print(path)
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.commands import create_or_update_cluster
     state = create_or_update_cluster(args.config_file)
@@ -291,6 +297,12 @@ def main(argv=None):
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--severity", default=None)
     sp.set_defaults(func=cmd_events)
+
+    sp = sub.add_parser(
+        "grafana",
+        help="generate importable Grafana dashboards for /metrics")
+    sp.add_argument("--out", default="./grafana_dashboards")
+    sp.set_defaults(func=cmd_grafana)
 
     sp = sub.add_parser("up", help="create/update a cluster from YAML")
     sp.add_argument("config_file")

@@ -105,6 +105,38 @@ def test_dashboard_events_logs_metrics(cluster):
     assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4.0' in metrics
 
 
+def test_grafana_dashboards_generated(tmp_path):
+    """Generated boards are valid Grafana JSON wired to the exported
+    metric names (reference: grafana_dashboard_factory.py)."""
+    import json
+    import re
+
+    from ray_tpu.dashboard.grafana import (generate_dashboards,
+                                           write_dashboards)
+    boards = generate_dashboards()
+    assert {"ray_tpu_core", "ray_tpu_scheduler", "ray_tpu_object_store",
+            "ray_tpu_nodes"} <= set(boards)
+    metric_re = re.compile(r"ray_tpu_[a-z_]+")
+    for doc in boards.values():
+        assert doc["panels"], doc["title"]
+        for p in doc["panels"]:
+            assert p["targets"], p["title"]
+            for t in p["targets"]:
+                assert metric_re.search(t["expr"]), t["expr"]
+        json.dumps(doc)  # serializable
+    # every expr references a gauge family the /metrics endpoint exports
+    exported_prefixes = (
+        "ray_tpu_cluster_", "ray_tpu_node_")
+    for doc in boards.values():
+        for p in doc["panels"]:
+            for t in p["targets"]:
+                assert any(pref in t["expr"]
+                           for pref in exported_prefixes), t["expr"]
+    paths = write_dashboards(str(tmp_path))
+    assert len(paths) == 4 and all(
+        json.load(open(p)) for p in paths)
+
+
 def test_dashboard_frontend_page(cluster):
     from ray_tpu.dashboard.dashboard import start_dashboard
     port = start_dashboard(port=18265)  # reuses the module's instance
