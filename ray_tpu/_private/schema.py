@@ -170,6 +170,15 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "processed_up_to": (_int, False),
         "caller": (_str, False),
     },
+    # ---- worker lifecycle (the second-language worker surface —
+    # docs/WIRE_PROTOCOL.md declares this table normative for it)
+    "worker_register": {"worker_id": (_str, True),
+                        "address": (_str, True)},
+    "push_task": {"spec": (_dict, True), "tpu_chips": (_list, False)},
+    "task_result": {"task_id": (_str, True), "returns": (_list, True),
+                    "app_error": (_bool, False)},
+    "ping": {},
+    "exit_worker": {},
     "dump_stacks": {},
     "node_stats": {},
     "dump_worker_stacks": {"worker_id": (_str, False)},

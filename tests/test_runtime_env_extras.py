@@ -155,9 +155,11 @@ esac
 
         env1, pid1 = ray_tpu.get(probe.remote(), timeout=120)
         assert env1 and "/conda/" in env1  # launched through the env
-        # same env -> pooled worker reused, no second env create
+        # same env -> same materialized env dir and NO second env
+        # create (the content-addressed cache; the pid may differ —
+        # the pool can hold several same-env workers)
         env2, pid2 = ray_tpu.get(probe.remote(), timeout=120)
-        assert env2 == env1 and pid2 == pid1
+        assert env2 == env1
         assert calls.read_text().count("created") == 1
     finally:
         os.environ.pop("CONDA_EXE", None)
