@@ -29,7 +29,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # Major bumps = incompatible framing/semantics; minor bumps = added
 # methods/fields (compatible both ways).
-PROTOCOL_VERSION = (1, 0)
+# 1.1: leases (lease_worker/release_lease/revoke_lease/leased_task),
+#      coalesced dispatch statuses, task_stats, profile_worker(s),
+#      worker-lifecycle methods joined the schema table.
+PROTOCOL_VERSION = (1, 1)
 
 _str = str
 _num = numbers.Number
