@@ -462,6 +462,93 @@ def _framework_main():
         ray_tpu.shutdown()
 
 
+# ---------------------------------------------------- data-ingest microbench
+
+def _data_ingest_main():
+    """Data-ingest microbenchmark (ISSUE 1): N blocks through a
+    read(sleep) -> map(sleep) two-stage chain, bulk vs streaming
+    executor.  Reports blocks/s and time-to-first-batch per mode.  The
+    two map_batches stages use different remote_opts so they do NOT fuse
+    — the stage skew is what bulk execution serializes and streaming
+    overlaps.  Prints one JSON line; also merged into the flagship line
+    as ingest_* keys by the supervisor."""
+    _force_cpu_platform()
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rt_data
+
+    n_blocks = int(os.environ.get("BENCH_INGEST_BLOCKS", 16))
+    read_s = float(os.environ.get("BENCH_INGEST_READ_S", 0.2))
+    map_s = float(os.environ.get("BENCH_INGEST_MAP_S", 0.2))
+    n_cpus = 4
+
+    def read_sim(b):
+        time.sleep(read_s)
+        return b
+
+    def map_sim(b):
+        time.sleep(map_s)
+        return b
+
+    ray_tpu.init(num_cpus=n_cpus, object_store_memory=512 * 1024**2,
+                 _system_config={"prestart_workers": False})
+    out = {}
+    try:
+        # warm the worker pool so neither mode pays spawn cost
+        rt_data.range(8, parallelism=8).map(lambda x: x).take_all()
+        # streaming keeps in-flight ~= cores so the head map task is not
+        # queued behind the whole read wave
+        os.environ["RTPU_DATA_MAX_INFLIGHT_TASKS"] = str(n_cpus)
+        for mode, key in (("0", "bulk"), ("1", "streaming")):
+            os.environ["RTPU_DATA_STREAMING"] = mode
+            t0 = time.perf_counter()
+            ds = (rt_data.range(n_blocks * 16, parallelism=n_blocks)
+                  .map_batches(read_sim, batch_format="numpy", num_cpus=1)
+                  .map_batches(map_sim, batch_format="numpy"))
+            it = ds.iter_batches(batch_size=16, batch_format="numpy")
+            first = next(it)
+            t_first = time.perf_counter() - t0
+            n = 1 + sum(1 for _ in it)
+            t_total = time.perf_counter() - t0
+            assert n == n_blocks and len(first) == 16
+            out[f"{key}_time_to_first_batch_s"] = round(t_first, 3)
+            out[f"{key}_total_s"] = round(t_total, 3)
+            out[f"{key}_blocks_per_s"] = round(n_blocks / t_total, 2)
+        out["blocks"] = n_blocks
+        out["chain_latency_s"] = read_s + map_s
+        out["ttfb_speedup"] = round(
+            out["bulk_time_to_first_batch_s"]
+            / out["streaming_time_to_first_batch_s"], 2)
+        out["throughput_vs_bulk"] = round(
+            out["streaming_blocks_per_s"] / out["bulk_blocks_per_s"], 3)
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({"metric": "data_ingest", **out}), flush=True)
+
+
+def _run_ingest_bench():
+    """Run the ingest microbench in a subprocess (CPU-only, cheap) and
+    return its keys prefixed ingest_*, or {} on any failure — it must
+    never sink the flagship line."""
+    env = dict(os.environ, _BENCH_DATA_INGEST="1", JAX_PLATFORMS="cpu")
+    env.pop("LIBTPU_INIT_ARGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, text=True, timeout=180, env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                if row.get("metric") == "data_ingest":
+                    row.pop("metric")
+                    return {f"ingest_{k}": v for k, v in row.items()}
+    except Exception:
+        pass
+    return {}
+
+
 # ----------------------------------------------------------------- supervise
 
 def _attempt(force_cpu: bool):
@@ -504,9 +591,11 @@ def _attempt(force_cpu: bool):
 def _supervise():
     errors = []
     delay = 5.0
+    ingest = _run_ingest_bench()  # CPU-only, runs before any TPU attempt
     for _ in range(ATTEMPTS):
         result, err = _attempt(force_cpu=False)
         if result is not None:
+            result.update(ingest)
             value = result.pop("img_per_sec_per_chip")
             _emit(value, round(value / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
                   **result)
@@ -516,6 +605,7 @@ def _supervise():
         delay = min(delay * 2, 30.0)
     result, err = _attempt(force_cpu=True)
     if result is not None:
+        result.update(ingest)
         value = result.pop("img_per_sec_per_chip")
         result["fallback"] = "cpu"
         result["tpu_errors"] = errors[:3]
@@ -530,6 +620,12 @@ def main():
     if os.environ.get("_BENCH_RAW"):
         try:
             _raw_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_DATA_INGEST"):
+        try:
+            _data_ingest_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

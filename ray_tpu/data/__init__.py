@@ -3,11 +3,20 @@
 Reference analogue: python/ray/data (Dataset over blocks, read API,
 transforms, shuffle, split, batch iteration). TPU-first: tensor-dict
 blocks, static-shape batch padding, jax.device_put prefetch iterators.
+
+Iteration runs on the streaming executor by default (RTPU_DATA_STREAMING,
+see _internal/streaming_executor.py): pending stages execute as a
+pull-based pipeline with object-store backpressure, so the first batch
+yields after the first block chain completes and the in-flight footprint
+stays bounded.  ``materialize()`` and the all-to-all barriers keep the
+bulk path; RTPU_DATA_STREAMING=0 falls back to it wholesale.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data._internal.compute import (ActorPoolStrategy,
                                             TaskPoolStrategy)
+from ray_tpu.data._internal.streaming_executor import (StreamingConfig,
+                                                       streaming_enabled)
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.grouped_data import GroupedData
@@ -25,4 +34,5 @@ __all__ = [
     "read_json", "read_numpy", "read_text", "read_binary_files",
     "read_images", "read_mongo",
     "read_datasource", "ActorPoolStrategy", "TaskPoolStrategy",
+    "StreamingConfig", "streaming_enabled",
 ]

@@ -247,6 +247,40 @@ class ExecutionPlan:
         self._stages = []
         return blocks
 
+    def supports_streaming(self) -> bool:
+        """Whether every stage can run under the streaming executor.
+        Actor-pool compute stages manage their own pool lifecycle in the
+        bulk helper and keep the bulk path."""
+        from ray_tpu.data._internal.compute import ActorPoolStrategy
+        for s in self._stages:
+            if (isinstance(s, OneToOneStage)
+                    and isinstance(s.remote_opts.get("_compute"),
+                                   ActorPoolStrategy)):
+                return False
+        return True
+
+    def execute_streaming(self):
+        """Iterator of (block_ref, bytes_or_None), executing pending
+        stages as a pull-based pipeline (streaming_executor.py): the
+        first output ref is yielded as soon as the first block's fused
+        chain completes, with bounded in-flight work behind it.
+
+        Unlike ``execute()`` this does NOT cache outputs: retaining every
+        output ref would pin O(dataset) in the object store, defeating
+        the bounded-footprint contract.  Consumers that need the
+        materialized ref list still call ``execute()``."""
+        if self._out_blocks is not None:
+            for r in self._out_blocks:
+                yield r, None
+            return
+        if not self._stages:
+            for r in self._in_blocks:
+                yield r, None
+            return
+        from ray_tpu.data._internal.streaming_executor import (
+            StreamingExecutor)
+        yield from StreamingExecutor(self).run()
+
     def metadata(self) -> List[Any]:
         """BlockMetadata per output block, computed once and cached."""
         if self._out_meta is None:

@@ -269,6 +269,28 @@ class Dataset:
         train, test = ds.split_at_indices([total - n_test])
         return train, test
 
+    def streaming_split(self, n: int) -> List["Dataset"]:
+        """Split into n shards WITHOUT materializing pending one-to-one
+        stages: input blocks are partitioned round-robin and every shard
+        carries the un-executed stage chain, so each consumer (e.g. a
+        train worker) streams its own shard through the pipeline.  Shards
+        are block-aligned, not row-equal — use ``split`` when exact row
+        balance matters.  Falls back to ``split`` when the plan is
+        already executed, has non-one-to-one stages, or has fewer input
+        blocks than shards."""
+        from ray_tpu.data._internal.plan import OneToOneStage
+        plan = self._plan
+        if (plan.is_executed()
+                or not all(isinstance(s, OneToOneStage)
+                           for s in plan._stages)
+                or len(plan._in_blocks) < n):
+            return self.split(n)
+        return [Dataset(ExecutionPlan(plan._in_blocks[i::n],
+                                      list(plan._stages),
+                                      stats=plan.stats.copy()),
+                        self._epoch)
+                for i in range(n)]
+
     def _split_ranges(self, refs, counts, ranges) -> List["Dataset"]:
         tasks = _shuffle._get_tasks()
         offsets = []
@@ -373,9 +395,7 @@ class Dataset:
             print(row)
 
     def iter_rows(self) -> Iterator[Any]:
-        import ray_tpu
-        for ref in self._blocks():
-            block = ray_tpu.get(ref)
+        for block in self._iter_blocks():
             yield from BlockAccessor.for_block(block).to_pylist()
 
     def iter_batches(self, *, batch_size: int = 256,
@@ -390,12 +410,16 @@ class Dataset:
         remainder batch (TPU-first; no reference analogue). ``pad_to_batch``
         wins over ``drop_last``: a padded remainder is always emitted.
         ``prefetch_blocks`` block pulls run ahead on a background thread so
-        object-store fetches overlap consumption."""
-        refs = self._blocks()
+        object-store fetches overlap consumption.
+
+        Under the streaming executor (RTPU_DATA_STREAMING, default on)
+        pending stages execute as a pull-based pipeline: the first batch
+        yields after the FIRST block's chain completes rather than the
+        last, with a bounded in-flight budget behind it."""
         shuffler = _LocalShuffler(local_shuffle_buffer_size,
                                   local_shuffle_seed)
         carry: Optional[Block] = None
-        for block in _iter_blocks_prefetch(refs, prefetch_blocks):
+        for block in self._iter_blocks(prefetch_blocks):
             block = shuffler.feed(block)
             if block is None:
                 continue
@@ -660,6 +684,23 @@ class Dataset:
     def _blocks(self) -> List[Any]:
         return self._plan.execute()
 
+    def _iter_blocks(self, prefetch_blocks: int = 1) -> Iterator[Block]:
+        """Yield block VALUES in order.  With the streaming executor
+        enabled and pending stages, blocks are produced by the pull-based
+        pipeline (O(depth) in-flight, first block available after one
+        chain); otherwise the plan bulk-materializes and blocks are
+        fetched with thread prefetch."""
+        from ray_tpu.data._internal.streaming_executor import (
+            streaming_enabled)
+        plan = self._plan
+        if (streaming_enabled() and not plan.is_executed()
+                and plan.supports_streaming()):
+            import ray_tpu
+            for ref, _ in plan.execute_streaming():
+                yield ray_tpu.get(ref)
+            return
+        yield from _iter_blocks_prefetch(self._blocks(), prefetch_blocks)
+
     def _meta(self) -> List[BlockMetadata]:
         return self._plan.metadata()
 
@@ -672,7 +713,13 @@ class Dataset:
 
 def _iter_blocks_prefetch(refs: List[Any], depth: int) -> Iterator[Block]:
     """Yield blocks with up to ``depth`` pulls running ahead on a background
-    thread, so object-store fetch of block N+1 overlaps consumption of N."""
+    thread, so object-store fetch of block N+1 overlaps consumption of N.
+
+    Generator close (an abandoned ``iter_batches`` iterator) must not leak
+    the thread: the finally clause signals stop, drains the queue so a
+    blocked ``put`` wakes, and joins the thread with a bounded timeout.
+    The thread stays daemonized so a ``get`` stuck on a lost object can
+    never pin process exit."""
     import ray_tpu
     if depth <= 0 or len(refs) <= 1:
         for r in refs:
@@ -688,6 +735,8 @@ def _iter_blocks_prefetch(refs: List[Any], depth: int) -> Iterator[Block]:
     def _pull():
         try:
             for r in refs:
+                if stop.is_set():
+                    return
                 b = ray_tpu.get(r)
                 while not stop.is_set():
                     try:
@@ -720,6 +769,12 @@ def _iter_blocks_prefetch(refs: List[Any], depth: int) -> Iterator[Block]:
             raise err[0]
     finally:
         stop.set()
+        try:  # unblock a producer stuck in q.put
+            while True:
+                q.get_nowait()
+        except _q.Empty:
+            pass
+        t.join(timeout=5.0)
 
 
 class _LocalShuffler:

@@ -30,12 +30,22 @@ class DatasetPipeline:
     @staticmethod
     def from_dataset_windows(ds, blocks_per_window: int) -> "DatasetPipeline":
         from ray_tpu.data.dataset import Dataset
-        from ray_tpu.data._internal.plan import ExecutionPlan
+        from ray_tpu.data._internal.plan import ExecutionPlan, OneToOneStage
 
         def gen():
-            refs = ds._blocks()
+            plan = ds._plan
+            if (not plan.is_executed()
+                    and all(isinstance(s, OneToOneStage)
+                            for s in plan._stages)):
+                # carry un-executed one-to-one stages into each window's
+                # plan instead of bulk-executing the whole dataset up
+                # front — a window then streams its own chain
+                refs, stages = plan._in_blocks, list(plan._stages)
+            else:
+                refs, stages = ds._blocks(), []
             for s in range(0, len(refs), blocks_per_window):
-                yield Dataset(ExecutionPlan(refs[s:s + blocks_per_window]))
+                yield Dataset(ExecutionPlan(refs[s:s + blocks_per_window],
+                                            list(stages)))
         return DatasetPipeline(gen)
 
     # ---------------------------------------------------------- transforms
@@ -77,6 +87,9 @@ class DatasetPipeline:
             yield ds
 
     def iter_batches(self, **kw) -> Iterator[Any]:
+        # each window rides the streaming executor via Dataset.iter_batches:
+        # batches start flowing after the window's FIRST block chain
+        # completes, not after the window fully executes
         for ds in self._windows():
             yield from ds.iter_batches(**kw)
 

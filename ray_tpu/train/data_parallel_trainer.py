@@ -171,10 +171,17 @@ class DataParallelTrainer(BaseTrainer):
 
     def _shard_datasets(self, num_workers: int) -> Dict[str, Any]:
         """Split each dataset into per-worker shards (reference:
-        RayDatasetSpec.get_dataset_shards)."""
+        RayDatasetSpec.get_dataset_shards).  With the streaming executor
+        enabled, shards of a pending map chain carry the un-executed
+        stages (Dataset.streaming_split) so each worker pipelines its own
+        ingest instead of waiting for a driver-side materialization."""
+        from ray_tpu.data._internal.streaming_executor import (
+            streaming_enabled)
         out: Dict[str, Any] = {}
         for name, ds in self.datasets.items():
-            if hasattr(ds, "split"):
+            if streaming_enabled() and hasattr(ds, "streaming_split"):
+                out[name] = ds.streaming_split(num_workers)
+            elif hasattr(ds, "split"):
                 out[name] = ds.split(num_workers)
             else:
                 out[name] = ds

@@ -10,6 +10,15 @@ from ray_tpu import data as rd
 pytestmark = pytest.mark.usefixtures("ray_start_shared")
 
 
+@pytest.fixture(autouse=True, params=["streaming", "bulk"])
+def _executor_mode(request, monkeypatch):
+    """The whole data suite runs under BOTH executor modes in one pytest
+    invocation: the streaming data-plane (default) and the bulk fallback
+    (RTPU_DATA_STREAMING=0)."""
+    monkeypatch.setenv("RTPU_DATA_STREAMING",
+                       "1" if request.param == "streaming" else "0")
+
+
 def test_range_count_take():
     ds = rd.range(100, parallelism=4)
     assert ds.count() == 100

@@ -3,9 +3,17 @@ data/_internal/stats.py DatasetStats; block splitting on
 target_max_block_size in the reference's map tasks)."""
 
 import numpy as np
+import pytest
 
 import ray_tpu
 import ray_tpu.data as rdata
+
+
+@pytest.fixture(autouse=True, params=["streaming", "bulk"])
+def _executor_mode(request, monkeypatch):
+    """Stats must hold under both executor modes in one invocation."""
+    monkeypatch.setenv("RTPU_DATA_STREAMING",
+                       "1" if request.param == "streaming" else "0")
 
 
 def test_stats_report_wall_cpu_rows(ray_start_shared):

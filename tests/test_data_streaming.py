@@ -1,0 +1,178 @@
+"""Streaming data-plane executor tests (tier-1, CPU-only).
+
+Covers the ISSUE-1 acceptance surface: time-to-first-batch precedes a
+slow tail block, the in-flight task/byte budgets are respected (asserted
+via the per-operator stats in Dataset.stats()), streaming and bulk
+produce identical rows for map/filter/repartition chains under both
+RTPU_DATA_STREAMING settings, pipeline windows yield mid-window, and the
+bulk path's prefetch thread no longer leaks on iterator abandonment.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+pytestmark = pytest.mark.usefixtures("ray_start_shared")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm_worker_pool(ray_start_shared):
+    """Spawn the worker pool once so the timing asserts below measure the
+    pipeline, not cold worker startup (~1s/proc on the CI box)."""
+    rd.range(8, parallelism=8).map(lambda x: x).take_all()
+
+
+@pytest.fixture(autouse=True)
+def _streaming_on(monkeypatch):
+    monkeypatch.setenv("RTPU_DATA_STREAMING", "1")
+
+
+def _slow_on(value, seconds):
+    def fn(batch):
+        if int(np.max(batch)) == value:
+            time.sleep(seconds)
+        return batch
+    return fn
+
+
+def test_first_batch_precedes_slow_tail_block():
+    # 8 single-row blocks; the LAST block's map sleeps 2s.  Streaming must
+    # yield the first batch after the first block chain, not the last.
+    ds = rd.range(8, parallelism=8).map_batches(
+        _slow_on(7, 2.0), batch_format="numpy")
+    t0 = time.perf_counter()
+    it = ds.iter_batches(batch_size=1, batch_format="numpy")
+    first = next(it)
+    t_first = time.perf_counter() - t0
+    rest = list(it)
+    t_total = time.perf_counter() - t0
+    assert first.tolist() == [0]
+    assert len(rest) == 7
+    assert t_total >= 1.8  # the tail block really did sleep
+    assert t_first < 1.2, f"first batch took {t_first:.2f}s (bulk-like)"
+
+
+def test_inflight_task_budget_respected(monkeypatch):
+    monkeypatch.setenv("RTPU_DATA_MAX_INFLIGHT_TASKS", "2")
+    ds = rd.range(64, parallelism=8).map_batches(
+        lambda b: b, batch_format="numpy")
+    rows = [v for b in ds.iter_batches(batch_size=8, batch_format="numpy")
+            for v in b.tolist()]
+    assert sorted(rows) == list(range(64))
+    row = [r for r in ds._plan.stats.to_dict()
+           if "map_batches" in r["stage"]][-1]
+    assert row["streaming"] is True
+    assert 1 <= row["peak_inflight_tasks"] <= 2, row
+    assert row["queue_depth_max"] <= 2, row
+    assert row["tasks"] == 8 and row["rows_out"] == 64
+
+
+def test_buffered_bytes_budget_respected(monkeypatch):
+    budget = 64 * 1024
+    monkeypatch.setenv("RTPU_DATA_MAX_BUFFERED_BYTES", str(budget))
+    # 8 blocks x 4 rows x 8 KiB/row = 32 KiB per block -> at most two
+    # blocks fit in flight under a 64 KiB budget
+    ds = rd.range_tensor(32, shape=(1024,), parallelism=8).map_batches(
+        lambda b: b, batch_format="numpy")
+    n = sum(1 for _ in ds.iter_batches(batch_size=4, batch_format="numpy"))
+    assert n == 8
+    row = [r for r in ds._plan.stats.to_dict()
+           if "map_batches" in r["stage"]][-1]
+    assert row["peak_buffered_bytes"] <= budget, row
+    assert row["peak_inflight_tasks"] <= 2, row
+    assert row["backpressure_wait_s"] >= 0
+
+
+@pytest.mark.parametrize("mode", ["1", "0"], ids=["streaming", "bulk"])
+def test_streaming_bulk_identical_rows(monkeypatch, mode):
+    monkeypatch.setenv("RTPU_DATA_STREAMING", mode)
+
+    def build():
+        return (rd.range(50, parallelism=5)
+                .map(lambda x: x + 1)
+                .filter(lambda x: x % 2 == 0)
+                .repartition(3)
+                .map_batches(lambda b: b * 2, batch_format="numpy"))
+
+    via_iter = [v for b in build().iter_batches(batch_size=7,
+                                                batch_format="numpy")
+                for v in b.tolist()]
+    via_rows = list(build().iter_rows())
+    via_bulk = build().take_all()  # take_all always bulk-materializes
+    expected = [(x + 1) * 2 for x in range(50) if (x + 1) % 2 == 0]
+    assert via_iter == expected
+    assert via_rows == expected
+    assert via_bulk == expected
+
+
+def test_partial_consumption_then_bulk_reuse():
+    # take() abandons the stream early; the plan stays lazy and a later
+    # bulk consumer still sees every row exactly once
+    ds = rd.range(32, parallelism=8).map(lambda x: x * 2)
+    assert ds.take(3) == [0, 2, 4]
+    assert ds.count() == 32
+    assert sorted(ds.take_all()) == [x * 2 for x in range(32)]
+
+
+def test_pipeline_window_yields_mid_window():
+    # one window of 8 blocks whose tail block sleeps: the first batch
+    # must arrive while the window is still executing (the pre-streaming
+    # executor fully executed each window before yielding)
+    pipe = rd.range(8, parallelism=8).window(blocks_per_window=8) \
+        .map_batches(_slow_on(7, 1.5), batch_format="numpy")
+    t0 = time.perf_counter()
+    it = pipe.iter_batches(batch_size=1, batch_format="numpy")
+    first = next(it)
+    t_first = time.perf_counter() - t0
+    rest = list(it)
+    assert first.tolist() == [0]
+    assert len(rest) == 7
+    assert t_first < 1.0, f"window bulk-executed ({t_first:.2f}s)"
+
+
+def test_streaming_split_carries_stages():
+    ds = rd.range(40, parallelism=8).map(lambda x: x + 100)
+    shards = ds.streaming_split(4)
+    assert len(shards) == 4
+    # every shard still has the un-executed map chain
+    assert all(s._plan._stages for s in shards)
+    vals = sorted(v for s in shards for v in s.iter_rows())
+    assert vals == [x + 100 for x in range(40)]
+    # an executed or all-to-all plan falls back to the row-equal split
+    eq = rd.range(40, parallelism=8).materialize().streaming_split(4)
+    assert [s.count() for s in eq] == [10, 10, 10, 10]
+
+
+def test_all_to_all_barrier_then_streaming_resumes():
+    ds = (rd.range(24, parallelism=6)
+          .map(lambda x: x + 1)
+          .random_shuffle(seed=11)
+          .map_batches(lambda b: b, batch_format="numpy"))
+    vals = sorted(v for b in ds.iter_batches(batch_size=5,
+                                             batch_format="numpy")
+                  for v in b.tolist())
+    assert vals == list(range(1, 25))
+    names = [r["stage"] for r in ds._plan.stats.to_dict()]
+    assert "random_shuffle" in names
+
+
+def test_prefetch_thread_joined_on_abandon(monkeypatch):
+    monkeypatch.setenv("RTPU_DATA_STREAMING", "0")
+    ds = rd.range(64, parallelism=8)
+    it = ds.iter_batches(batch_size=8, batch_format="numpy",
+                         prefetch_blocks=3)
+    next(it)
+    it.close()  # abandon mid-stream; close must reap the prefetch thread
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+            t.name == "rtpu-data-prefetch" for t in threading.enumerate()):
+        time.sleep(0.05)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name == "rtpu-data-prefetch"]
+    assert not leaked, leaked
