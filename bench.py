@@ -549,6 +549,179 @@ def _run_ingest_bench():
     return {}
 
 
+# ------------------------------------------------------- serve data-plane bench
+
+class _BenchSeqCounter:
+    """Named-actor sequence so the Nth-constructed replica can tell it is
+    the Nth (the skewed-replica picker below)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def next(self):
+        self.n += 1
+        return self.n
+
+
+def _serve_bench_main():
+    """Serve data-plane benchmark (_BENCH_SERVE=1): closed-loop clients
+    through the handle and HTTP paths, reporting RPS/p50/p99 for
+    round-robin vs power-of-two-choices routing under skewed replica
+    load, and fixed-window vs adaptive micro-batching (idle p50 +
+    loaded RPS). CPU-only; one JSON line."""
+    _force_cpu_platform()
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    duration = float(os.environ.get("BENCH_SERVE_DURATION", 3.0))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    service_ms = float(os.environ.get("BENCH_SERVE_SERVICE_MS", 5.0))
+    skew = float(os.environ.get("BENCH_SERVE_SKEW", 10.0))
+
+    def closed_loop(fn, n_clients, dur):
+        lat, errors = [], [0]
+        lock = threading.Lock()
+        stop = time.perf_counter() + dur
+
+        def worker():
+            local = []
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                try:
+                    fn()
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                local.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(local)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not lat:
+            return {"rps": 0.0, "p50_ms": 0.0, "p99_ms": 0.0,
+                    "errors": errors[0]}
+        arr = np.asarray(lat)
+        return {"rps": round(len(lat) / dur, 1),
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+                "errors": errors[0]}
+
+    class SkewedEcho:
+        """One replica serves at service_s, its sibling skew× slower —
+        the asymmetry blind round-robin cannot see."""
+
+        def __init__(self, service_s, skew_factor):
+            import ray_tpu as rt
+            try:
+                ctr = rt.get_actor("BENCH_SERVE_SEQ")
+            except Exception:
+                try:
+                    ctr = rt.remote(name="BENCH_SERVE_SEQ",
+                                    lifetime="detached")(
+                        _BenchSeqCounter).remote()
+                except Exception:  # sibling replica won the race
+                    ctr = rt.get_actor("BENCH_SERVE_SEQ")
+            idx = rt.get(ctr.next.remote())
+            self.delay = service_s * (skew_factor if idx % 2 == 0
+                                      else 1.0)
+
+        def __call__(self, x):
+            time.sleep(self.delay)
+            return x
+
+    def make_batched(adaptive_mode):
+        class BatchedEcho:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05,
+                         adaptive=adaptive_mode, submit_timeout_s=30.0)
+            def run(self, items):
+                time.sleep(0.002)  # one fixed-cost "model step" per flush
+                return list(items)
+
+            def __call__(self, x):
+                return self.run(x)
+        return BatchedEcho
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024,
+                 _system_config={"prestart_workers": False})
+    out = {"duration_s": duration, "clients": clients,
+           "service_ms": service_ms, "skew": skew}
+    try:
+        # ---- routing: 2 skewed replicas, handle path, rr vs p2c ----
+        h = serve.run(
+            serve.deployment(num_replicas=2, max_concurrent_queries=32)(
+                SkewedEcho).bind(service_ms / 1e3, skew),
+            name="routing", route_prefix="/skew", http_port=8200)
+
+        def handle_call():
+            ray_tpu.get(h.remote(1), timeout=30.0)
+
+        for _ in range(8):
+            handle_call()  # warm replicas + router telemetry
+        for policy in ("round_robin", "p2c"):
+            os.environ["RTPU_SERVE_ROUTING"] = policy
+            time.sleep(1.2)  # let a fresh replica_load long-poll land
+            st = closed_loop(handle_call, clients, duration)
+            for k, v in st.items():
+                out[f"route_{policy}_{k}"] = v
+        if out["route_round_robin_rps"]:
+            out["p2c_vs_rr_rps"] = round(
+                out["route_p2c_rps"] / out["route_round_robin_rps"], 3)
+
+        # ---- HTTP path (p2c), same skewed deployment ----
+        import urllib.request
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        port = ray_tpu.get(proxy.get_port.remote())
+
+        def http_call():
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/skew?x=1", timeout=30).read()
+
+        http_call()
+        st = closed_loop(http_call, clients, duration)
+        for k, v in st.items():
+            out[f"http_{k}"] = v
+
+        # ---- batching: fixed window vs adaptive ----
+        for mode in ("fixed", "adaptive"):
+            dep = serve.deployment(
+                num_replicas=1, max_concurrent_queries=64)(
+                make_batched(mode == "adaptive"))
+            hb = serve.run(dep.options(name=f"Batched_{mode}").bind(),
+                           name=f"batch_{mode}",
+                           route_prefix=f"/batch_{mode}", http_port=None)
+
+            def batch_call(hb=hb):
+                ray_tpu.get(hb.remote(1), timeout=30.0)
+
+            batch_call()
+            idle = closed_loop(batch_call, 1, duration)  # idle queue
+            loaded = closed_loop(batch_call, 2 * clients, duration)
+            out[f"batch_{mode}_idle_p50_ms"] = idle["p50_ms"]
+            out[f"batch_{mode}_idle_p99_ms"] = idle["p99_ms"]
+            out[f"batch_{mode}_rps"] = loaded["rps"]
+            out[f"batch_{mode}_p99_ms"] = loaded["p99_ms"]
+        if out["batch_adaptive_idle_p50_ms"]:
+            out["adaptive_idle_p50_speedup"] = round(
+                out["batch_fixed_idle_p50_ms"]
+                / out["batch_adaptive_idle_p50_ms"], 2)
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+    print(json.dumps({"metric": "serve_dataplane", **out}), flush=True)
+
+
 # ----------------------------------------------------------------- supervise
 
 def _attempt(force_cpu: bool):
@@ -626,6 +799,12 @@ def main():
     elif os.environ.get("_BENCH_DATA_INGEST"):
         try:
             _data_ingest_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_SERVE"):
+        try:
+            _serve_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -11,6 +11,8 @@ from ray_tpu.serve.api import (Application, Deployment, delete,
                                get_deployment_handle, list_applications,
                                run, shutdown, start, status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.exceptions import (BatchSubmitTimeoutError,
+                                      ReplicaOverloadedError)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.ingress import APIRouter, ingress
 from ray_tpu.serve._private.autoscaling import AutoscalingConfig
@@ -21,4 +23,5 @@ __all__ = [
     "get_deployment_handle", "Deployment", "Application",
     "DeploymentHandle", "batch", "AutoscalingConfig",
     "APIRouter", "ingress",
+    "ReplicaOverloadedError", "BatchSubmitTimeoutError",
 ]

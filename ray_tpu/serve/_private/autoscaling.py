@@ -2,6 +2,13 @@
 
 Reference analogue: serve/_private/autoscaling_policy.py (policy on
 per-replica ongoing-request metrics from autoscaling_metrics.py).
+
+The controller feeds ``get_decision`` the summed per-replica
+``queue_len`` (executing requests + the bounded ingress waiting room,
+from ``ReplicaActor.get_load``) rather than ongoing requests alone: a
+replica whose execution slots are saturated keeps registering rising
+load through its queue, so backpressure shows up as scale-out pressure
+instead of being invisible behind the concurrency cap.
 """
 
 from __future__ import annotations
@@ -32,6 +39,8 @@ class AutoscalingPolicy:
 
     def get_decision(self, current_replicas: int,
                      total_ongoing: float, now: float) -> int:
+        """``total_ongoing`` is the deployment-wide queue depth
+        (executing + queued across replicas)."""
         c = self.config
         if current_replicas == 0:
             return c.min_replicas

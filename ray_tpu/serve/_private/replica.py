@@ -1,18 +1,44 @@
 """Replica actor — hosts one copy of a deployment's user callable.
 
 Reference analogue: serve/_private/replica.py:250 (RayServeReplica,
-handle_request:494). Concurrency comes from the actor's thread pool
-(``max_concurrency`` = the deployment's ``max_concurrent_queries``);
-``num_ongoing_requests`` feeds both router backpressure and the
-controller's autoscaling policy.
+handle_request:494). Concurrency comes from the actor's thread pool;
+user-code concurrency is gated by an execution semaphore of
+``max_concurrent_queries`` slots, with a bounded waiting room of
+``max_queued_requests`` on top. A request arriving past both limits is
+shed immediately with a retriable ``ReplicaOverloadedError`` instead of
+queueing unboundedly (the router retries it on another replica; the
+HTTP proxy maps exhaustion to 503).
+
+The replica also tracks its own load telemetry — queue depth
+(executing + waiting) and an EWMA of service time — which the
+controller collects into the ``replica_load`` long-poll key for
+load-aware routing and autoscaling, and which piggybacks on proxy
+responses via ``handle_request_with_load``.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-import traceback
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.exceptions import ReplicaOverloadedError
+
+# EWMA smoothing for per-request service time: heavy enough to damp
+# bimodal request mixes, light enough to track a warmup->steady change
+# within ~10 requests.
+_EWMA_ALPHA = 0.3
+
+
+def _default_max_queued(max_concurrent_queries: int) -> int:
+    env = os.environ.get("RTPU_SERVE_MAX_QUEUED")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return 2 * max_concurrent_queries
 
 
 class ReplicaActor:
@@ -21,7 +47,9 @@ class ReplicaActor:
     def __init__(self, deployment_name: str, serialized_callable: bytes,
                  init_args: tuple, init_kwargs: dict,
                  user_config: Optional[Any] = None,
-                 version: str = ""):
+                 version: str = "",
+                 max_concurrent_queries: int = 100,
+                 max_queued_requests: Optional[int] = None):
         import cloudpickle
         self.deployment_name = deployment_name
         self.version = version
@@ -32,20 +60,65 @@ class ReplicaActor:
         else:
             self.callable = fn_or_cls
             self._is_function = True
+        self._max_concurrent = max(1, int(max_concurrent_queries))
+        if max_queued_requests is None:
+            max_queued_requests = _default_max_queued(self._max_concurrent)
+        self._max_queued = max(0, int(max_queued_requests))
+        # user code runs under this semaphore; threads past it wait in
+        # the bounded "queued" room counted by admission control below
+        self._exec_sem = threading.Semaphore(self._max_concurrent)
         self._ongoing = 0
+        self._queued = 0
         self._ongoing_lock = threading.Lock()
         self._total_requests = 0
         self._total_errors = 0
+        self._total_shed = 0
         self._latency_sum = 0.0
+        self._ewma_s = 0.0
+        self._have_ewma = False
         if user_config is not None:
             self.reconfigure(user_config)
+        # bucket-prewarm hook: a callable may define __serve_prewarm__
+        # (typically calling a @serve.batch method's .prewarm) so every
+        # pad bucket compiles at startup instead of on the first unlucky
+        # request. Failures must not kill the replica.
+        if not self._is_function and hasattr(self.callable,
+                                             "__serve_prewarm__"):
+            try:
+                self.callable.__serve_prewarm__()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    # ---- request path ----
 
     def handle_request(self, method_name: str, args: tuple,
                        kwargs: dict) -> Any:
-        t0 = time.time()
+        return self._execute(method_name, args, kwargs)
+
+    def handle_request_with_load(self, method_name: str, args: tuple,
+                                 kwargs: dict) -> Dict[str, Any]:
+        """Proxy path: the result envelope piggybacks this replica's
+        current load so the proxy's router sees queue depth at response
+        latency, not at the next long-poll tick."""
+        result = self._execute(method_name, args, kwargs)
+        return {"__serve_result__": result, "__serve_load__": self.get_load()}
+
+    def _execute(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        t0 = time.monotonic()
         with self._ongoing_lock:
-            self._ongoing += 1
+            in_flight = self._ongoing + self._queued
+            limit = self._max_concurrent + self._max_queued
+            if in_flight >= limit:
+                self._total_shed += 1
+                raise ReplicaOverloadedError(self.deployment_name,
+                                             in_flight, limit)
+            self._queued += 1
             self._total_requests += 1
+        self._exec_sem.acquire()
+        with self._ongoing_lock:
+            self._queued -= 1
+            self._ongoing += 1
         try:
             if self._is_function:
                 target = self.callable
@@ -57,9 +130,17 @@ class ReplicaActor:
                 self._total_errors += 1
             raise
         finally:
+            self._exec_sem.release()
+            dt = time.monotonic() - t0
             with self._ongoing_lock:
                 self._ongoing -= 1
-                self._latency_sum += time.time() - t0
+                self._latency_sum += dt
+                if self._have_ewma:
+                    self._ewma_s += _EWMA_ALPHA * (dt - self._ewma_s)
+                else:
+                    self._ewma_s, self._have_ewma = dt, True
+
+    # ---- control plane ----
 
     def reconfigure(self, user_config: Any):
         """Apply a new user_config without restarting the replica
@@ -67,13 +148,30 @@ class ReplicaActor:
         if not self._is_function and hasattr(self.callable, "reconfigure"):
             self.callable.reconfigure(user_config)
 
+    def get_load(self) -> Dict[str, Any]:
+        """Cheap telemetry snapshot: what the router's power-of-two-
+        choices scoring consumes (piggybacked + long-poll refreshed)."""
+        with self._ongoing_lock:
+            return {
+                "queue_len": self._ongoing + self._queued,
+                "ewma_s": self._ewma_s,
+                "shed": self._total_shed,
+                "ts": time.time(),
+            }
+
     def get_metrics(self) -> Dict[str, Any]:
         with self._ongoing_lock:
             return {
                 "num_ongoing_requests": self._ongoing,
+                "num_queued_requests": self._queued,
+                "queue_len": self._ongoing + self._queued,
                 "total_requests": self._total_requests,
                 "total_errors": self._total_errors,
+                "total_shed": self._total_shed,
                 "latency_sum_s": self._latency_sum,
+                "ewma_service_time_s": self._ewma_s,
+                "max_concurrent_queries": self._max_concurrent,
+                "max_queued_requests": self._max_queued,
             }
 
     def check_health(self) -> str:

@@ -1,74 +1,180 @@
-"""Client-side request router with backpressure.
+"""Client-side request router with load-aware replica selection.
 
 Reference analogue: serve/_private/router.py:261 (Router,
-assign_request:298) + the ReplicaSet power-of-queue logic (:62). Each
+assign_request:298) + the PowerOfTwoChoicesReplicaScheduler. Each
 handle/proxy owns a Router that long-polls the controller for the live
-replica membership and picks the least-loaded replica under
-``max_concurrent_queries``, counting its own in-flight requests.
+replica membership AND per-replica load telemetry (queue depth + EWMA
+service time, published on the ``replica_load`` key and piggybacked on
+proxy responses), then picks replicas with power-of-two-choices over
+reported queue lengths. When telemetry is stale the score falls back to
+this router's own in-flight counts; ``RTPU_SERVE_ROUTING=round_robin``
+(or a per-deployment ``routing_policy``) restores blind round-robin.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import ray_tpu
+from ray_tpu import exceptions as rexc
 from ray_tpu.actor import get_actor_by_id
 from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve.exceptions import ReplicaOverloadedError
+
+logger = logging.getLogger("ray_tpu.serve.router")
+
+# transport/control-plane failures a routing refresh may legitimately
+# hit; anything else (TypeError, KeyError, ...) is a bug and must raise
+_REFRESH_ERRORS = (rexc.RayTpuError, TimeoutError, ConnectionError,
+                   OSError)
+
+
+def _load_staleness_s() -> float:
+    try:
+        return float(os.environ.get("RTPU_SERVE_LOAD_STALENESS_S", 5.0))
+    except ValueError:
+        return 5.0
+
+
+def _default_policy() -> str:
+    return os.environ.get("RTPU_SERVE_ROUTING", "p2c").strip().lower()
+
+
+def is_overload_error(err: BaseException) -> bool:
+    """True when an exception raised at ``get()`` means the replica shed
+    the request (retriable on another replica)."""
+    if isinstance(err, ReplicaOverloadedError):
+        return True
+    cause = getattr(err, "cause", None)
+    if isinstance(cause, ReplicaOverloadedError):
+        return True
+    # defensive: the cause survives the object plane only if picklable;
+    # fall back to the type name in the captured traceback
+    return (isinstance(err, rexc.TaskError)
+            and "ReplicaOverloadedError" in str(err))
 
 
 class ReplicaSet:
-    """Tracks live replicas of one deployment + per-replica in-flight."""
+    """Tracks live replicas of one deployment: per-replica local
+    in-flight counts plus replica-reported load telemetry."""
 
     def __init__(self, deployment_name: str, max_concurrent_queries: int):
         self.deployment_name = deployment_name
         self.max_concurrent_queries = max_concurrent_queries
+        self.routing_policy: Optional[str] = None  # None → env default
         self._replicas: List[Any] = []       # actor handles
         self._in_flight: Dict[str, int] = {}  # actor id hex -> count
+        # actor id hex -> {"queue_len", "ewma_s", "ts"} as reported by
+        # the replica (long-poll refresh or response piggyback)
+        self._reports: Dict[str, Dict[str, float]] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._rr = 0
+        self._rng = random.Random()
 
     def update_replicas(self, replicas: List[Any],
-                        max_concurrent_queries: Optional[int] = None):
+                        max_concurrent_queries: Optional[int] = None,
+                        routing_policy: Optional[str] = None):
         with self._cv:
             self._replicas = list(replicas)
             if max_concurrent_queries:
                 self.max_concurrent_queries = max_concurrent_queries
+            if routing_policy is not None:
+                self.routing_policy = routing_policy
             live = {r._id_hex for r in self._replicas}
             self._in_flight = {k: v for k, v in self._in_flight.items()
                                if k in live}
+            self._reports = {k: v for k, v in self._reports.items()
+                             if k in live}
             self._cv.notify_all()
 
-    def assign(self, timeout: float = 30.0):
-        """Round-robin over replicas with < max_concurrent_queries of OUR
-        in-flight requests; blocks when all are saturated."""
+    def record_report(self, replica_id: str, queue_len: float,
+                      ewma_s: float = 0.0, ts: Optional[float] = None):
+        """Fold in a replica-reported load sample (long-poll snapshot or
+        response piggyback); newer timestamps win."""
+        ts = time.time() if ts is None else ts
+        with self._cv:
+            cur = self._reports.get(replica_id)
+            if cur is None or ts >= cur["ts"]:
+                self._reports[replica_id] = {"queue_len": float(queue_len),
+                                             "ewma_s": float(ewma_s or 0.0),
+                                             "ts": ts}
+
+    # ---- selection ----
+
+    def _score(self, key: str, now: float) -> Tuple[float, float]:
+        """(queue score, EWMA tiebreak). Fresh replica-reported queue
+        depth is the primary signal — it sees load from EVERY router —
+        plus our own in-flight (requests the report can't know about
+        yet). Stale telemetry degrades to local counts only."""
+        local = self._in_flight.get(key, 0)
+        rep = self._reports.get(key)
+        if rep is not None and now - rep["ts"] <= _load_staleness_s():
+            return (rep["queue_len"] + local, rep["ewma_s"])
+        return (float(local), 0.0)
+
+    def _pick(self, candidates: List[Any]) -> Any:
+        policy = self.routing_policy or _default_policy()
+        if policy == "round_robin" or len(candidates) == 1:
+            # preserve arrival order relative to the full replica list
+            n = len(self._replicas)
+            cand_ids = {c._id_hex for c in candidates}
+            for off in range(n):
+                r = self._replicas[(self._rr + off) % n]
+                if r._id_hex in cand_ids:
+                    self._rr = (self._rr + off + 1) % n
+                    return r
+            return candidates[0]
+        # power of two choices: sample two distinct replicas, take the
+        # one with the lower queue score (EWMA service time tiebreaks)
+        now = time.time()
+        a, b = self._rng.sample(candidates, 2)
+        sa, sb = self._score(a._id_hex, now), self._score(b._id_hex, now)
+        return a if sa <= sb else b
+
+    def assign(self, timeout: float = 30.0,
+               exclude: Optional[Set[str]] = None):
+        """Pick a replica with < max_concurrent_queries of OUR in-flight
+        requests; blocks when all are saturated (backpressure)."""
         deadline = time.time() + timeout
+        exclude = exclude or set()
         with self._cv:
             while True:
-                n = len(self._replicas)
-                for off in range(n):
-                    r = self._replicas[(self._rr + off) % n] if n else None
-                    if r is None:
-                        break
+                candidates = [
+                    r for r in self._replicas
+                    if r._id_hex not in exclude
+                    and (self._in_flight.get(r._id_hex, 0)
+                         < self.max_concurrent_queries)]
+                if candidates:
+                    r = self._pick(candidates)
                     key = r._id_hex
-                    if (self._in_flight.get(key, 0)
-                            < self.max_concurrent_queries):
-                        self._rr = (self._rr + off + 1) % n
-                        self._in_flight[key] = \
-                            self._in_flight.get(key, 0) + 1
-                        return r
+                    self._in_flight[key] = self._in_flight.get(key, 0) + 1
+                    return r
                 remaining = deadline - time.time()
                 if remaining <= 0:
+                    # build the message from CURRENT state under the
+                    # lock — update_replicas may have raced the wait
+                    # loop, and a stale count here sends the operator
+                    # chasing the wrong replica set
+                    n = len(self._replicas)
+                    n_excluded = sum(1 for r in self._replicas
+                                     if r._id_hex in exclude)
+                    in_flight = sum(self._in_flight.get(r._id_hex, 0)
+                                    for r in self._replicas)
                     raise TimeoutError(
                         f"no replica available for "
                         f"{self.deployment_name!r} within {timeout}s "
-                        f"({n} replicas, all at "
-                        f"{self.max_concurrent_queries} in-flight)")
+                        f"({n} replicas, {n_excluded} excluded, "
+                        f"{in_flight} total in-flight, cap "
+                        f"{self.max_concurrent_queries}/replica)")
                 self._cv.wait(timeout=min(remaining, 1.0))
 
-    def release(self, replica):
+    def release(self, replica, service_time_s: Optional[float] = None):
         with self._cv:
             key = replica._id_hex
             if key in self._in_flight:
@@ -79,7 +185,8 @@ class ReplicaSet:
 
 
 class Router:
-    """Routes requests for many deployments; refreshed via long-poll."""
+    """Routes requests for many deployments; membership and load
+    telemetry refreshed via long-poll."""
 
     def __init__(self, controller_handle):
         self._controller = controller_handle
@@ -87,30 +194,66 @@ class Router:
         self._lock = threading.Lock()
         self._poller = LongPollClient(
             controller_handle, "route_table", self._on_update)
+        self._load_poller = LongPollClient(
+            controller_handle, "replica_load", self._on_load_update)
         # seed synchronously so the first request doesn't race the poller
         try:
             _, snapshot = ray_tpu.get(
                 controller_handle.get_route_table.remote())
             if snapshot:
                 self._on_update(snapshot)
-        except Exception:
-            pass
+        except _REFRESH_ERRORS as e:
+            logger.warning(
+                "router: initial route-table seed from controller failed "
+                "(%s: %s); falling back to the long-poll — the first "
+                "request may see a brief 'unknown deployment' window",
+                type(e).__name__, e)
 
     def _on_update(self, snapshot: Optional[Dict[str, Any]]):
         if not snapshot:
             return
         with self._lock:
             for name, info in snapshot.items():
-                replicas = [get_actor_by_id(h)
-                            for h in info["replicas"]]
+                replicas = []
+                for h in info["replicas"]:
+                    try:
+                        replicas.append(get_actor_by_id(h))
+                    except (ValueError, *_REFRESH_ERRORS) as e:
+                        # replica died between table publish and our
+                        # resolve; the controller's health check will
+                        # push a corrected table
+                        logger.warning(
+                            "router: replica %s of %r unresolvable "
+                            "(%s: %s); skipping until the next table "
+                            "update", h[:8], name, type(e).__name__, e)
                 s = self._sets.get(name)
                 if s is None:
                     s = ReplicaSet(name, info["max_concurrent_queries"])
                     self._sets[name] = s
                 s.update_replicas(replicas,
-                                  info["max_concurrent_queries"])
+                                  info["max_concurrent_queries"],
+                                  info.get("routing_policy"))
             for gone in set(self._sets) - set(snapshot):
                 self._sets.pop(gone)
+
+    def _on_load_update(self, snapshot: Optional[Dict[str, Any]]):
+        """``replica_load`` long-poll: {deployment: {replica_id:
+        {queue_len, ewma_s, ts}}} collected by the controller."""
+        if not snapshot:
+            return
+        with self._lock:
+            sets = dict(self._sets)
+        for name, per_replica in snapshot.items():
+            s = sets.get(name)
+            if s is None:
+                continue
+            for replica_id, load in (per_replica or {}).items():
+                try:
+                    s.record_report(replica_id, load["queue_len"],
+                                    load.get("ewma_s", 0.0),
+                                    load.get("ts"))
+                except (KeyError, TypeError):
+                    continue
 
     def replica_set(self, deployment_name: str) -> ReplicaSet:
         with self._lock:
@@ -134,16 +277,76 @@ class Router:
             _, snapshot = ray_tpu.get(
                 self._controller.get_route_table.remote(), timeout=10.0)
             self._on_update(snapshot)
-        except Exception:
-            pass
+        except _REFRESH_ERRORS as e:
+            logger.warning(
+                "router: route-table refresh failed for deployments %s "
+                "(%s: %s); keeping the previous table until the "
+                "long-poll catches up", sorted(self._sets),
+                type(e).__name__, e)
 
     def assign_request(self, deployment_name: str, method_name: str,
-                       args: tuple, kwargs: dict):
-        """Pick a replica, fire the call, return (ObjectRef, done_cb)."""
+                       args: tuple, kwargs: dict,
+                       timeout: float = 30.0,
+                       exclude: Optional[Set[str]] = None):
+        """Pick a replica, fire the call; returns (ObjectRef, done_cb,
+        replica handle)."""
         rs = self.replica_set(deployment_name)
-        replica = rs.assign()
+        replica = rs.assign(timeout=timeout, exclude=exclude)
         ref = replica.handle_request.remote(method_name, args, kwargs)
-        return ref, lambda: rs.release(replica)
+        return ref, lambda: rs.release(replica), replica
+
+    def execute_request(self, deployment_name: str, method_name: str,
+                        args: tuple, kwargs: dict, *,
+                        get_timeout: float = 60.0,
+                        assign_timeout: float = 30.0,
+                        overload_retries: Optional[int] = None) -> Any:
+        """Synchronous request with overload retry — the proxy hot path.
+
+        Uses the replica's envelope method so each response piggybacks
+        current load into this router's telemetry. A shed request
+        (``ReplicaOverloadedError``) is retried on a different replica
+        up to ``overload_retries`` times (env
+        ``RTPU_SERVE_OVERLOAD_RETRIES``, default 3); exhaustion
+        re-raises the overload error for the caller to map (the HTTP
+        proxy returns 503)."""
+        if overload_retries is None:
+            try:
+                overload_retries = int(os.environ.get(
+                    "RTPU_SERVE_OVERLOAD_RETRIES", 3))
+            except ValueError:
+                overload_retries = 3
+        rs = self.replica_set(deployment_name)
+        exclude: Set[str] = set()
+        last_err: Optional[BaseException] = None
+        for _ in range(max(1, overload_retries + 1)):
+            replica = rs.assign(timeout=assign_timeout, exclude=exclude)
+            ref = replica.handle_request_with_load.remote(
+                method_name, args, kwargs)
+            try:
+                out = ray_tpu.get(ref, timeout=get_timeout)
+            except Exception as e:
+                if is_overload_error(e):
+                    # shed: the replica is full — don't pick it again
+                    # for this request, try the others
+                    exclude.add(replica._id_hex)
+                    rs.record_report(replica._id_hex,
+                                     queue_len=float("inf"))
+                    last_err = e
+                    continue
+                raise
+            finally:
+                rs.release(replica)
+            if isinstance(out, dict) and "__serve_result__" in out:
+                load = out.get("__serve_load__")
+                if isinstance(load, dict):
+                    rs.record_report(replica._id_hex,
+                                     load.get("queue_len", 0),
+                                     load.get("ewma_s", 0.0),
+                                     load.get("ts"))
+                return out["__serve_result__"]
+            return out
+        raise last_err
 
     def stop(self):
         self._poller.stop()
+        self._load_poller.stop()

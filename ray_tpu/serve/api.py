@@ -88,12 +88,23 @@ class Deployment:
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                max_concurrent_queries: int = 100,
+               max_queued_requests: Optional[int] = None,
+               routing_policy: Optional[str] = None,
                user_config: Optional[Any] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                route_prefix: Optional[str] = None,
                pass_http_path: bool = False):
     """@serve.deployment — mark a class/function as a deployment.
+
+    ``max_queued_requests`` bounds each replica's ingress waiting room
+    on top of ``max_concurrent_queries`` execution slots (default: env
+    ``RTPU_SERVE_MAX_QUEUED``, else 2x max_concurrent_queries); a
+    request past both limits is shed with a retriable
+    ``ReplicaOverloadedError`` (HTTP 503 at the proxy).
+    ``routing_policy`` pins this deployment's replica selection to
+    ``"p2c"`` (power-of-two-choices over reported queue depths, the
+    default) or ``"round_robin"``; unset defers to ``RTPU_SERVE_ROUTING``.
 
     ``pass_http_path=True`` makes the HTTP proxy pass the request path
     below the route prefix as a ``__serve_path__`` kwarg — the contract
@@ -106,6 +117,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             {
                 "num_replicas": num_replicas,
                 "max_concurrent_queries": max_concurrent_queries,
+                "max_queued_requests": max_queued_requests,
+                "routing_policy": routing_policy,
                 "user_config": user_config,
                 "autoscaling_config": autoscaling_config,
                 "ray_actor_options": ray_actor_options,

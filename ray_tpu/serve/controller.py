@@ -55,6 +55,7 @@ class ServeController:
         self._shutdown = threading.Event()
         self._http_port = http_port
         self._last_error: Optional[str] = None
+        self._last_load_table: Dict[str, Any] = {}
         self._reconcile_thread = threading.Thread(
             target=self._control_loop, daemon=True)
         self._reconcile_thread.start()
@@ -171,7 +172,7 @@ class ServeController:
         while not self._shutdown.is_set():
             try:
                 self._reconcile_once()
-                self._autoscale_tick()
+                self._metrics_tick()
                 self._health_check()
                 self._last_error = None
             except Exception:
@@ -184,14 +185,19 @@ class ServeController:
         from ray_tpu.serve._private.replica import ReplicaActor
         cfg = info.config
         self._replica_seq += 1
+        mcq = cfg.get("max_concurrent_queries", 100)
+        max_queued = cfg.get("max_queued_requests")
+        if max_queued is None:
+            from ray_tpu.serve._private.replica import _default_max_queued
+            max_queued = _default_max_queued(mcq)
         opts = dict(
             name=f"SERVE_REPLICA::{name}#{self._replica_seq}",
-            # Headroom over max_concurrent_queries: check_health/get_metrics
-            # share the replica's concurrency slots with user requests, and
-            # each router independently admits max_concurrent_queries — a
-            # saturated replica must still answer control probes or the
-            # controller kills it while healthy.
-            max_concurrency=cfg.get("max_concurrent_queries", 100) + 4,
+            # The actor thread pool must hold executing requests (mcq) +
+            # the bounded waiting room (max_queued: threads parked on the
+            # replica's execution semaphore) + headroom so a saturated
+            # replica still answers check_health/get_load control probes
+            # — admission control sheds anything beyond that.
+            max_concurrency=mcq + max_queued + 4,
             lifetime="detached",
         )
         if cfg.get("ray_actor_options"):
@@ -202,7 +208,9 @@ class ServeController:
             tuple(cfg.get("init_args") or ()),
             dict(cfg.get("init_kwargs") or {}),
             user_config=cfg.get("user_config"),
-            version=info.version)
+            version=info.version,
+            max_concurrent_queries=mcq,
+            max_queued_requests=max_queued)
         info.replicas[h] = info.version
 
     def _stop_replica(self, handle):
@@ -259,6 +267,9 @@ class ServeController:
                                  for h in info.replicas],
                     "max_concurrent_queries":
                         info.config.get("max_concurrent_queries", 100),
+                    "max_queued_requests":
+                        info.config.get("max_queued_requests"),
+                    "routing_policy": info.config.get("routing_policy"),
                     "route_prefix": info.config.get("route_prefix"),
                     "pass_http_path":
                         bool(info.config.get("pass_http_path")),
@@ -293,24 +304,41 @@ class ServeController:
             self._publish_route_table()
             self._reconcile_once()
 
-    def _autoscale_tick(self):
+    def _metrics_tick(self):
+        """Collect per-replica load (queue depth incl. the bounded
+        waiting room + EWMA service time), publish it on the
+        ``replica_load`` long-poll key for load-aware routing, and feed
+        the same queue metrics to the autoscaler."""
         import ray_tpu
         now = time.time()
         with self._lock:
             items = [(name, info, list(info.replicas))
                      for name, info in self._deployments.items()
-                     if info.autoscaler is not None
-                     and not info.config.get("_deleted")]
+                     if not info.config.get("_deleted")]
+        load_table: Dict[str, Dict[str, Any]] = {}
         for name, info, handles in items:
-            total = 0.0
+            per_replica = {}
+            total_queue = 0.0
             for h in handles:
                 try:
-                    m = ray_tpu.get(h.get_metrics.remote(), timeout=5.0)
-                    total += m["num_ongoing_requests"]
+                    load = ray_tpu.get(h.get_load.remote(), timeout=5.0)
+                    per_replica[h._id_hex] = load
+                    total_queue += load.get("queue_len", 0)
                 except Exception:
+                    # dead/slow replica: the health check owns removal;
+                    # routers just won't get a fresh report for it
                     pass
-            decision = info.autoscaler.get_decision(
-                len(handles), total, now)
-            if decision != info.target_replicas:
-                with self._lock:
-                    info.target_replicas = decision
+            if per_replica:
+                load_table[name] = per_replica
+            if info.autoscaler is not None:
+                # queue_len (ongoing + queued) — a replica with a full
+                # waiting room now registers as load even when its
+                # execution slots cap num_ongoing
+                decision = info.autoscaler.get_decision(
+                    len(handles), total_queue, now)
+                if decision != info.target_replicas:
+                    with self._lock:
+                        info.target_replicas = decision
+        if load_table or self._last_load_table:
+            self._last_load_table = load_table
+            self._long_poll.notify_changed("replica_load", load_table)

@@ -52,7 +52,7 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         router = _get_router(self._controller)
-        ref, release = router.assign_request(
+        ref, release, _replica = router.assign_request(
             self.deployment_name, self._method_name or "__call__",
             args, kwargs)
         # completion callback (no value fetch, no waiter thread); if the

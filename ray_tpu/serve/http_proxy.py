@@ -3,13 +3,18 @@
 Reference analogue: serve/_private/http_proxy.py:387 (HTTPProxyActor,
 HTTPProxy.__call__:312 over uvicorn/ASGI). Here: a stdlib
 ThreadingHTTPServer inside an actor; each request thread routes through
-the shared backpressure-aware Router, so HTTP and handle traffic obey
-the same ``max_concurrent_queries`` flow control.
+the shared backpressure-aware Router (load-aware selection + overload
+retry on other replicas), so HTTP and handle traffic obey the same
+``max_concurrent_queries`` flow control. A saturated deployment sheds:
+when every replica is at capacity (router assign times out after
+``RTPU_SERVE_PROXY_ASSIGN_TIMEOUT_S``) or the overload retries exhaust,
+the proxy answers a retriable 503 instead of queueing unboundedly.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -99,10 +104,17 @@ class HTTPProxyActor:
                     payload = {k: v[0] if len(v) == 1 else v
                                for k, v in q.items()} if q else None
                 from ray_tpu import exceptions as rexc
+                from ray_tpu.serve._private.router import \
+                    is_overload_error
                 last_err: Optional[Exception] = None
                 # only idempotent requests are retried — a POST may have
                 # run side effects on the replica before it died
                 attempts = 4 if self.command == "GET" else 1
+                try:
+                    assign_timeout = float(os.environ.get(
+                        "RTPU_SERVE_PROXY_ASSIGN_TIMEOUT_S", 5.0))
+                except ValueError:
+                    assign_timeout = 5.0
                 for attempt in range(attempts):
                     try:
                         kwargs = {}
@@ -117,14 +129,11 @@ class HTTPProxyActor:
                             if getattr(proxy, "_pass_method",
                                        {}).get(name):
                                 kwargs["__serve_method__"] = self.command
-                        ref, release = proxy._router.assign_request(
+                        result = proxy._router.execute_request(
                             name, "__call__",
                             (payload,) if payload is not None else (),
-                            kwargs)
-                        try:
-                            result = ray_tpu.get(ref, timeout=60.0)
-                        finally:
-                            release()
+                            kwargs, get_timeout=60.0,
+                            assign_timeout=assign_timeout)
                         if isinstance(result, dict) and \
                                 "__serve_http_status__" in result:
                             # structured routing miss from an ingress
@@ -134,6 +143,20 @@ class HTTPProxyActor:
                                 {"error": result.get("error")})
                             return
                         self._respond(200, result)
+                        return
+                    except rexc.GetTimeoutError as e:
+                        # the replica accepted the request but didn't
+                        # answer in time — not an overload signal
+                        self._respond(504, {"error": repr(e)})
+                        return
+                    except TimeoutError as e:
+                        # router assign timed out: every replica is at
+                        # max_concurrent_queries — shed with a
+                        # retriable 503 instead of queueing unboundedly
+                        self._respond(503, {
+                            "error": f"deployment {name!r} saturated: "
+                                     f"{e}",
+                            "retryable": True})
                         return
                     except (rexc.ActorDiedError,
                             rexc.ActorUnavailableError) as e:
@@ -149,6 +172,15 @@ class HTTPProxyActor:
                             break
                         name, route_prefix = fresh
                     except Exception as e:
+                        if is_overload_error(e):
+                            # every retry landed on a full replica —
+                            # bounded queues shed, the client retries
+                            self._respond(503, {
+                                "error": f"deployment {name!r} "
+                                         f"overloaded: {e}".split(
+                                             "\n")[0],
+                                "retryable": True})
+                            return
                         self._respond(500, {"error": repr(e)})
                         return
                 if attempts == 1:

@@ -19,6 +19,10 @@ class DeploymentSchema(BaseModel):
     name: str
     num_replicas: Optional[int] = None
     max_concurrent_queries: Optional[int] = None
+    # bounded ingress waiting room + replica-selection policy
+    # (docs/SERVE_DATAPLANE.md)
+    max_queued_requests: Optional[int] = None
+    routing_policy: Optional[str] = None
     user_config: Optional[Any] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
