@@ -549,6 +549,70 @@ def _run_ingest_bench():
     return {}
 
 
+# --------------------------------------------------- checkpoint microbench
+
+def _ckpt_bench_main():
+    """Checkpoint-engine microbench (_BENCH_CKPT=1): how long the train
+    step is blocked per save, sync vs async, on a multi-MB pytree.
+
+    Each mode runs the same loop: mutate state, save, then "train" for
+    BENCH_CKPT_STEP_MS (the compute an async writer overlaps). Sync mode
+    (RTPU_CKPT_ASYNC=0) blocks for snapshot+write+checksum+fsync+commit;
+    async blocks only for the host snapshot (+ any backpressure when the
+    previous write hasn't landed). No cluster needed; one JSON line."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.checkpoint import AsyncCheckpointer, CheckpointManager
+
+    mb = float(os.environ.get("BENCH_CKPT_MB", 64))
+    saves = int(os.environ.get("BENCH_CKPT_SAVES", 4))
+    step_ms = float(os.environ.get("BENCH_CKPT_STEP_MS", 200))
+    n_leaves = 8
+    leaf_elems = max(1, int(mb * 1024 ** 2 / 4 / n_leaves))
+    rng = np.random.default_rng(0)
+    state = {"params": {f"w{i}": rng.standard_normal(leaf_elems)
+                        .astype(np.float32) for i in range(n_leaves)},
+             "step": np.zeros((), np.int32)}
+    total_mb = sum(a.nbytes for a in state["params"].values()) / 1024 ** 2
+    out = {"pytree_mb": round(total_mb, 1), "saves": saves,
+           "step_ms": step_ms}
+    for mode in ("sync", "async"):
+        os.environ["RTPU_CKPT_ASYNC"] = "1" if mode == "async" else "0"
+        root = tempfile.mkdtemp(prefix=f"rtpu_ckpt_bench_{mode}_")
+        try:
+            mgr = CheckpointManager(root, num_to_keep=2)
+            ck = AsyncCheckpointer(mgr)
+            blocked = []
+            t_all = time.perf_counter()
+            for s in range(saves):
+                state["step"] = state["step"] + 1
+                t0 = time.perf_counter()
+                ck.save(s, state)
+                blocked.append(time.perf_counter() - t0)
+                time.sleep(step_ms / 1e3)  # the overlapped train step
+            ck.finalize()
+            wall = time.perf_counter() - t_all
+            assert mgr.latest_committed() == saves - 1, \
+                f"{mode}: expected step {saves - 1} committed"
+            stats = ck.stats
+            out[f"{mode}_blocked_ms_per_save"] = round(
+                1e3 * sum(blocked) / saves, 2)
+            out[f"{mode}_snapshot_ms_mean"] = round(
+                sum(st.snapshot_ms for st in stats) / saves, 2)
+            out[f"{mode}_write_ms_mean"] = round(
+                sum(st.write_ms for st in stats) / saves, 2)
+            out[f"{mode}_wall_s"] = round(wall, 3)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    out["blocked_frac_vs_sync"] = round(
+        out["async_blocked_ms_per_save"]
+        / max(out["sync_blocked_ms_per_save"], 1e-9), 4)
+    print(json.dumps({"metric": "checkpoint", **out}), flush=True)
+
+
 # ------------------------------------------------------- serve data-plane bench
 
 class _BenchSeqCounter:
@@ -799,6 +863,12 @@ def main():
     elif os.environ.get("_BENCH_DATA_INGEST"):
         try:
             _data_ingest_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_CKPT"):
+        try:
+            _ckpt_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -50,23 +50,45 @@ class Checkpoint:
         if os.path.exists(blob):
             with open(blob, "rb") as f:
                 return pickle.load(f)
+        # raw-file checkpoint: walk recursively so sharded layouts
+        # (process_<i>/ subdirs) flatten to relative-path keys instead of
+        # raising IsADirectoryError
         out: Dict[str, Any] = {}
-        for name in os.listdir(self._dir):
-            with open(os.path.join(self._dir, name), "rb") as f:
-                out[name] = f.read()
+        for dirpath, _dirnames, filenames in os.walk(self._dir):
+            for name in filenames:
+                fpath = os.path.join(dirpath, name)
+                with open(fpath, "rb") as f:
+                    out[os.path.relpath(fpath, self._dir)] = f.read()
         return out
 
     def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize into ``path`` crash-safely: content is written to a
+        staging dir next to the target and swapped in with an atomic
+        rename, so a failure mid-write leaves either the old directory or
+        nothing — never a half-materialized checkpoint."""
         if path is None:
             path = os.path.join(tempfile.gettempdir(), "rtpu_ckpt",
                                 uuid.uuid4().hex)
-        os.makedirs(path, exist_ok=True)
-        if self._dir is not None:
-            if os.path.abspath(self._dir) != os.path.abspath(path):
-                shutil.copytree(self._dir, path, dirs_exist_ok=True)
-        else:
-            with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
-                pickle.dump(self._data, f, protocol=5)
+        path = os.path.abspath(path)
+        if self._dir is not None and os.path.abspath(self._dir) == path:
+            return path
+        parent = os.path.dirname(path)
+        os.makedirs(parent, exist_ok=True)
+        staging = tempfile.mkdtemp(
+            prefix=f".{os.path.basename(path)}.part-", dir=parent)
+        try:
+            if self._dir is not None:
+                shutil.copytree(self._dir, staging, dirs_exist_ok=True)
+            else:
+                with open(os.path.join(staging, "checkpoint.pkl"),
+                          "wb") as f:
+                    pickle.dump(self._data, f, protocol=5)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            os.rename(staging, path)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
         return path
 
     def to_object_ref(self):
@@ -117,37 +139,21 @@ class ShardedCheckpoint:
         self.root = root
 
     def save(self, state, process_index: Optional[int] = None) -> str:
+        """Write this process's owned shards. Filenames are deterministic
+        sanitized ``key__shard<i>.npy`` (str hash() is salted per process
+        — the old ``abs(hash((key, index)))`` names differed across hosts
+        and could collide). Replicated shards (replica_id != 0) and, on
+        processes other than 0, host-resident leaves are skipped so each
+        shard is written exactly once across the gang."""
         import jax
-        import numpy as np
-        from jax.tree_util import tree_flatten_with_path
+
+        from ray_tpu.checkpoint.async_checkpointer import (
+            snapshot_to_host, write_host_snapshot)
 
         idx = process_index if process_index is not None \
             else jax.process_index()
         pdir = os.path.join(self.root, f"process_{idx}")
-        os.makedirs(pdir, exist_ok=True)
-        leaves, _ = tree_flatten_with_path(state)
-        manifest = []
-        for path, leaf in leaves:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                           for p in path)
-            if hasattr(leaf, "addressable_shards"):
-                for shard in leaf.addressable_shards:
-                    fname = f"{abs(hash((key, str(shard.index))))}.npy"
-                    np.save(os.path.join(pdir, fname),
-                            np.asarray(shard.data))
-                    manifest.append({"key": key, "file": fname,
-                                     "index": _index_to_json(shard.index),
-                                     "shape": list(leaf.shape),
-                                     "dtype": str(leaf.dtype)})
-            else:
-                fname = f"{abs(hash((key, 'full')))}.npy"
-                np.save(os.path.join(pdir, fname), np.asarray(leaf))
-                manifest.append({"key": key, "file": fname, "index": None,
-                                 "shape": list(np.shape(leaf)),
-                                 "dtype": str(np.asarray(leaf).dtype)})
-        import json
-        with open(os.path.join(pdir, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
+        write_host_snapshot(pdir, snapshot_to_host(state, idx))
         return self.root
 
     def restore(self, target_state):

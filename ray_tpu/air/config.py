@@ -58,6 +58,10 @@ class CheckpointConfig:
     checkpoint_score_order: str = "max"
     checkpoint_frequency: int = 0
     checkpoint_at_end: bool = False
+    # TPU-first addition, consumed by ray_tpu.checkpoint.CheckpointManager:
+    # steps divisible by k survive num_to_keep eviction (milestone
+    # checkpoints for post-hoc eval on a preemptible pod)
+    keep_every_k: int = 0
 
 
 @dataclass

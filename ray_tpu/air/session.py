@@ -36,6 +36,12 @@ class _Session:
     stop_event: threading.Event = field(default_factory=threading.Event)
     tpu_chips: tuple = ()
     mesh: Any = None  # the SPMD island's jax Mesh, set by the backend
+    # durable checkpoint engine (ray_tpu.checkpoint): set up by the
+    # backend when the run has a checkpoint root; report(checkpoint=...)
+    # then stages through the manager instead of shipping payloads in-band
+    checkpoint_manager: Any = None
+    ckpt_next_step: int = 0
+    async_checkpointer: Any = None
 
 
 _tls = threading.local()
@@ -58,14 +64,64 @@ def report(metrics: Dict[str, Any], *, checkpoint=None):
     s = _get_session()
     if s is None:
         raise RuntimeError("session.report() called outside a train session")
+    if checkpoint is not None and s.checkpoint_manager is not None:
+        checkpoint = _route_through_manager(s, checkpoint)
     s.result_queue.put(TrainingResult(dict(metrics), checkpoint))
     if s.stop_event.is_set():
         raise StopIteration("session stopped")
 
 
+def _route_through_manager(s: _Session, checkpoint):
+    """Stage the payload under the durable checkpoint root and ship only a
+    PendingCheckpoint marker; the driver commits after the round barrier
+    (all ranks staged). Replicated dict/dir payloads are written by rank 0
+    only; a PendingCheckpoint (from an AsyncCheckpointer the train_func
+    drives itself) passes through untouched."""
+    from ray_tpu.checkpoint import PendingCheckpoint
+    if isinstance(checkpoint, PendingCheckpoint):
+        s.ckpt_next_step = max(s.ckpt_next_step, checkpoint.step + 1)
+        return checkpoint
+    step = s.ckpt_next_step
+    s.ckpt_next_step += 1
+    if s.world_rank == 0:
+        s.checkpoint_manager.stage(step, checkpoint)
+    return PendingCheckpoint(step)
+
+
 def get_checkpoint():
     s = _get_session()
     return s.checkpoint if s else None
+
+
+def get_checkpoint_manager():
+    """The run's durable CheckpointManager, or None when the run has no
+    checkpoint root configured (RunConfig.name/storage_path)."""
+    s = _get_session()
+    return s.checkpoint_manager if s else None
+
+
+def next_checkpoint_step() -> int:
+    """The step number the next staged checkpoint will get (monotonic,
+    continues across gang restarts)."""
+    s = _get_session()
+    return s.ckpt_next_step if s else 0
+
+
+def get_async_checkpointer():
+    """This worker's AsyncCheckpointer bound to the run's checkpoint root
+    (lazily created). Train funcs use it for sharded SPMD state:
+    ``pending = ckpter.save(session.next_checkpoint_step(), state)`` then
+    ``session.report(metrics, checkpoint=pending)`` — the driver commits
+    once every rank's write lands. Returns None without a manager."""
+    s = _get_session()
+    if s is None or s.checkpoint_manager is None:
+        return None
+    if s.async_checkpointer is None:
+        from ray_tpu.checkpoint import AsyncCheckpointer
+        s.async_checkpointer = AsyncCheckpointer(
+            s.checkpoint_manager, process_index=s.world_rank,
+            process_count=s.world_size, commit=False)
+    return s.async_checkpointer
 
 
 def get_dataset_shard(name: str = "train"):

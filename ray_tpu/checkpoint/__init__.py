@@ -1,0 +1,22 @@
+"""ray_tpu.checkpoint — the durable checkpoint engine.
+
+A step-numbered checkpoint root with atomic commit (write to
+``tmp_step_N/``, per-file checksums in the manifest, fsync, rename +
+``COMMIT`` marker), retention driven by ``air.config.CheckpointConfig``,
+and async sharded saves that block the train step only for the host
+snapshot. See docs/CHECKPOINTING.md for the layout and commit protocol.
+
+No reference analogue in the seed (python/ray checkpointing is
+storage-backend glue); the save path is orbax-style: every process
+writes only the shards it owns, a single committer seals the step.
+"""
+
+from ray_tpu.checkpoint.manager import (  # noqa: F401
+    COMMIT_MARKER, MANIFEST_NAME, CheckpointManager, PendingCheckpoint)
+from ray_tpu.checkpoint.async_checkpointer import (  # noqa: F401
+    AsyncCheckpointer, SaveStats, snapshot_to_host)
+
+__all__ = [
+    "CheckpointManager", "AsyncCheckpointer", "PendingCheckpoint",
+    "SaveStats", "snapshot_to_host", "COMMIT_MARKER", "MANIFEST_NAME",
+]

@@ -111,8 +111,9 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
                            sm_scale: Optional[float] = None):
     """Convenience wrapper: runs ring_attention under shard_map on `mesh`
     with [b, h, s, d] inputs sharded over the sequence dim."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.jax_compat import shard_map
 
     spec = P(None, None, axis_name, None)
 

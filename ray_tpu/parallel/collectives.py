@@ -101,7 +101,7 @@ class CollectiveGroup:
     def _reduce_fn(self, op: str):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from ray_tpu.parallel.jax_compat import shard_map
 
         red = {"sum": jax.lax.psum, "mean": jax.lax.pmean,
                "max": jax.lax.pmax, "min": jax.lax.pmin}[op]

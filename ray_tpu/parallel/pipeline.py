@@ -49,7 +49,9 @@ def pipeline_apply(stage_fn: Callable, mesh, *, axis: str = "pp",
 
     def _pipelined(stage_params, microbatches):
         s_idx = jax.lax.axis_index(axis)
-        size = jax.lax.axis_size(axis)
+        # jax.lax.axis_size doesn't exist on older jax; the mesh is
+        # static and in scope, so take the size from it
+        size = mesh.shape[axis]
         m = microbatches.shape[0]
         t_total = m + size - 1
 
@@ -80,7 +82,8 @@ def pipeline_apply(stage_fn: Callable, mesh, *, axis: str = "pp",
 
     # manual over pp only; dp/tp/sp remain GSPMD-auto inside — XLA shards
     # the per-stage math over the other axes exactly as it would un-piped
-    return jax.shard_map(
+    from ray_tpu.parallel.jax_compat import shard_map
+    return shard_map(
         _pipelined, mesh=mesh,
         in_specs=(P(axis), P()), out_specs=P(),
         axis_names={axis}, check_vma=False)

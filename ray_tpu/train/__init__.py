@@ -11,6 +11,8 @@ from ray_tpu.air.session import (  # noqa: F401
 from ray_tpu.air.checkpoint import Checkpoint, ShardedCheckpoint  # noqa: F401
 from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig, FailureConfig, RunConfig, ScalingConfig)
+from ray_tpu.checkpoint import (  # noqa: F401
+    AsyncCheckpointer, CheckpointManager, PendingCheckpoint)
 from ray_tpu.train.gbdt_trainer import (  # noqa: F401
     GBDTTrainer, LightGBMTrainer, SklearnGBDTTrainer, XGBoostTrainer)
 from ray_tpu.train.torch_trainer import (  # noqa: F401

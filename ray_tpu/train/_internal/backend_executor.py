@@ -60,7 +60,9 @@ class BackendExecutor:
 
     def start_training(self, train_func: Callable, config: Dict[str, Any],
                        checkpoint=None, dataset_shards: Optional[Dict] = None,
-                       trial_info: Optional[Dict[str, str]] = None):
+                       trial_info: Optional[Dict[str, str]] = None,
+                       checkpoint_root: Optional[str] = None,
+                       ckpt_start_step: int = 0):
         wg = self.worker_group
         n = wg.num_workers
         # node/local ranks from sorted metadata
@@ -81,7 +83,9 @@ class BackendExecutor:
                 checkpoint=checkpoint,
                 trial_name=trial_info.get("trial_name", ""),
                 trial_id=trial_info.get("trial_id", ""),
-                experiment_name=trial_info.get("experiment_name", "")))
+                experiment_name=trial_info.get("experiment_name", ""),
+                checkpoint_root=checkpoint_root,
+                ckpt_start_step=ckpt_start_step))
             local_counter[nid] += 1
         ray_tpu.get(refs, timeout=120)
         if dataset_shards:
@@ -131,6 +135,11 @@ class BackendExecutor:
                 return [TrainingResult(r["metrics"], r.get("checkpoint"))
                         if r else TrainingResult({}) for r in results]
         raise TrainingFailedError("timed out waiting for worker results")
+
+    def wait_for_checkpoints(self, timeout: float = 300.0) -> List[Any]:
+        """Barrier over every rank's in-flight async checkpoint write —
+        the precondition for the driver committing a step."""
+        return self.worker_group.execute("wait_checkpoint", timeout=timeout)
 
     def finish(self) -> List[Any]:
         return self.worker_group.execute("get_error")

@@ -43,14 +43,34 @@ class TrainWorker:
                       node_rank: int, world_size: int,
                       trial_name: str = "", trial_id: str = "",
                       experiment_name: str = "",
-                      checkpoint=None) -> bool:
+                      checkpoint=None,
+                      checkpoint_root: str = None,
+                      ckpt_start_step: int = 0) -> bool:
+        ckpt_manager = None
+        if checkpoint_root:
+            # workers only stage into the root; commit/retention is the
+            # driver's job (it owns the all-ranks round barrier)
+            from ray_tpu.checkpoint import CheckpointManager
+            ckpt_manager = CheckpointManager(checkpoint_root)
         self._session = air_session._Session(
             world_rank=world_rank, local_rank=local_rank,
             node_rank=node_rank, world_size=world_size,
             trial_name=trial_name, trial_id=trial_id,
             experiment_name=experiment_name, checkpoint=checkpoint,
-            tpu_chips=tuple(ray_tpu.get_tpu_ids()))
+            tpu_chips=tuple(ray_tpu.get_tpu_ids()),
+            checkpoint_manager=ckpt_manager,
+            ckpt_next_step=ckpt_start_step)
         return True
+
+    def wait_checkpoint(self):
+        """Barrier until this worker's in-flight async checkpoint write
+        (if any) has landed; returns its per-save stats. The driver calls
+        this on every rank before committing a step."""
+        s = self._session
+        if s is None or s.async_checkpointer is None:
+            return []
+        s.async_checkpointer.wait()
+        return [st.as_dict() for st in s.async_checkpointer.stats]
 
     def set_dataset_shard(self, name: str, shard) -> bool:
         self._session.dataset_shards[name] = shard

@@ -10,6 +10,7 @@ mesh and uses pjit/psum — no NCCL, no DDP wrappers.
 from __future__ import annotations
 
 import logging
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -103,29 +104,73 @@ class DataParallelTrainer(BaseTrainer):
     def fit(self) -> Result:
         return self._fit_internal(report_through_session=False)
 
+    def _checkpoint_root(self) -> Optional[str]:
+        """Durable checkpoint root for this run, or None (in-band
+        checkpoints, the pre-engine behavior). A run opts into the engine
+        by having a stable identity: RunConfig.storage_path and/or name."""
+        rc = self.run_config
+        if rc.storage_path is None and rc.name is None:
+            return None
+        base = rc.storage_path or os.environ.get(
+            "RTPU_RESULTS_DIR", os.path.expanduser("~/ray_tpu_results"))
+        return os.path.join(os.path.expanduser(base), rc.name or "train",
+                            "checkpoints")
+
+    def _make_checkpoint_manager(self):
+        root = self._checkpoint_root()
+        if root is None:
+            return None
+        from ray_tpu.checkpoint import CheckpointManager
+        return CheckpointManager(
+            root, checkpoint_config=self.run_config.checkpoint_config)
+
     def _fit_internal(self, report_through_session: bool) -> Result:
         failure_cfg = self.run_config.failure_config
         attempts_left = max(failure_cfg.max_failures, 0)
         infinite = failure_cfg.max_failures == -1
         checkpoint = self.resume_from_checkpoint
+        manager = self._make_checkpoint_manager()
+        if checkpoint is None and manager is not None:
+            # gang restart across driver restarts: resume from the newest
+            # fully-committed step (partial/corrupt steps are skipped)
+            latest = manager.latest_committed()
+            if latest is not None:
+                checkpoint = manager.load(latest)
+                logger.info("resuming from committed checkpoint step %d",
+                            latest)
         while True:
             try:
-                return self._run_once(checkpoint, report_through_session)
+                return self._run_once(checkpoint, report_through_session,
+                                      manager)
             except TrainingFailedError as e:
                 logger.warning("training attempt failed: %s", e)
                 if not infinite and attempts_left <= 0:
                     return Result(error=str(e), checkpoint=checkpoint)
                 attempts_left -= 1
-                checkpoint = self._latest_checkpoint or checkpoint
+                if manager is not None:
+                    # a worker that died mid-save leaves an uncommitted
+                    # tmp/step dir — latest_committed() skips it, so the
+                    # gang restarts from the last *intact* step
+                    latest = manager.latest_committed()
+                    checkpoint = (manager.load(latest)
+                                  if latest is not None
+                                  else self.resume_from_checkpoint)
+                else:
+                    checkpoint = self._latest_checkpoint or checkpoint
                 logger.warning(
                     "restarting gang from last checkpoint (%s retries left)",
                     "inf" if infinite else attempts_left)
 
-    def _run_once(self, checkpoint, report_through_session: bool) -> Result:
+    def _run_once(self, checkpoint, report_through_session: bool,
+                  manager=None) -> Result:
         from ray_tpu.air import session as air_session
         executor = BackendExecutor(self.scaling_config, self.backend_config)
         self._latest_checkpoint = checkpoint
         trial_id = uuid.uuid4().hex[:8]
+        ckpt_start_step = 0
+        if manager is not None:
+            latest = manager.latest_committed()
+            ckpt_start_step = latest + 1 if latest is not None else 0
         try:
             executor.start()
             dataset_shards = self._shard_datasets(
@@ -135,7 +180,9 @@ class DataParallelTrainer(BaseTrainer):
                 checkpoint=checkpoint, dataset_shards=dataset_shards,
                 trial_info={"trial_id": trial_id,
                             "trial_name": self.run_config.name or
-                            f"train-{trial_id}"})
+                            f"train-{trial_id}"},
+                checkpoint_root=manager.root if manager else None,
+                ckpt_start_step=ckpt_start_step)
             history: List[Dict[str, Any]] = []
             last_metrics: Dict[str, Any] = {}
             while True:
@@ -148,6 +195,9 @@ class DataParallelTrainer(BaseTrainer):
                 ckpt = next((r.checkpoint for r in round_results
                              if r.checkpoint is not None), None)
                 if ckpt is not None:
+                    ckpt = self._commit_round_checkpoint(
+                        executor, manager, round_results, ckpt)
+                if ckpt is not None:
                     self._latest_checkpoint = ckpt
                 if report_through_session and air_session.in_session():
                     air_session.report(rank0.metrics,
@@ -159,6 +209,33 @@ class DataParallelTrainer(BaseTrainer):
                           metrics_history=history)
         finally:
             executor.shutdown()
+
+    def _commit_round_checkpoint(self, executor, manager, round_results,
+                                 ckpt):
+        """Seal a staged step once the whole gang has reported it. The
+        round itself is the barrier: every rank staged (sync dict payloads)
+        or enqueued (async sharded saves) before reporting; we wait out
+        in-flight writers, then commit atomically. Returns the committed
+        directory-backed Checkpoint, or None if the step can't be sealed
+        (the previous intact step stays latest)."""
+        from ray_tpu.checkpoint import PendingCheckpoint
+        if not isinstance(ckpt, PendingCheckpoint):
+            return ckpt  # in-band payload (no manager configured)
+        if manager is None:
+            logger.warning("dropping PendingCheckpoint(step=%d): driver "
+                           "has no checkpoint manager", ckpt.step)
+            return None
+        step = max(r.checkpoint.step for r in round_results
+                   if isinstance(r.checkpoint, PendingCheckpoint))
+        try:
+            executor.wait_for_checkpoints()
+            manager.commit_step(step)
+            return manager.load(step)
+        except Exception as e:  # noqa: BLE001 — a torn save must not
+            # kill training; the previous committed step remains latest
+            logger.warning("checkpoint step %d failed to commit: %r",
+                           step, e)
+            return None
 
     def _should_stop(self, metrics: Dict[str, Any]) -> bool:
         stop = self.run_config.stop

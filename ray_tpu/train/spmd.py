@@ -386,6 +386,59 @@ def make_pipelined_lm_trainer(
                        eval_loss=jax.jit(eval_loss_fn))
 
 
+def make_async_checkpointer(manager=None, **kwargs):
+    """An AsyncCheckpointer for SPMD train state. With ``manager=None``
+    inside a train session, binds to the run's durable checkpoint root
+    (the driver commits after the gang round barrier); standalone callers
+    pass their own CheckpointManager and get self-committing saves.
+
+    Usage in a train_func::
+
+        ckpter = spmd.make_async_checkpointer()
+        ...
+        pending = ckpter.save(session.next_checkpoint_step(), state)
+        session.report(metrics, checkpoint=pending)   # blocks only for
+        ...                                           # the host snapshot
+        ckpter.finalize()                             # before returning
+    """
+    from ray_tpu.checkpoint import AsyncCheckpointer
+    if manager is None:
+        from ray_tpu.air import session as air_session
+        ckpter = air_session.get_async_checkpointer()
+        if ckpter is None:
+            raise RuntimeError(
+                "no checkpoint manager in the session — set "
+                "RunConfig.name/storage_path, or pass manager= explicitly")
+        return ckpter
+    return AsyncCheckpointer(manager, **kwargs)
+
+
+def restore_spmd_state(target_state, *, manager=None, checkpoint=None,
+                       step: Optional[int] = None):
+    """Restore a sharded checkpoint onto ``target_state``'s shardings.
+
+    World-size/mesh independent: shards are keyed by *global* index
+    slices, so a state saved by 8 processes on a (dp=4, tp=2) mesh
+    reassembles onto 1 process with a (dp=2,) mesh (and vice versa) —
+    each leaf is rebuilt full on host and ``device_put`` re-shards it to
+    the target layout. Source: a CheckpointManager (committed step, with
+    checksum verification under RTPU_CKPT_VERIFY=1), a directory-backed
+    air.Checkpoint, or the session's manager."""
+    from ray_tpu.air.checkpoint import ShardedCheckpoint
+    if manager is None and checkpoint is None:
+        from ray_tpu.air import session as air_session
+        manager = air_session.get_checkpoint_manager()
+        if manager is None:
+            checkpoint = air_session.get_checkpoint()
+    if manager is not None:
+        return manager.restore_state(target_state, step=step)
+    root = getattr(checkpoint, "_dir", None)
+    if root is None:
+        raise ValueError("restore_spmd_state needs a CheckpointManager or "
+                         "a directory-backed Checkpoint")
+    return ShardedCheckpoint(root).restore(target_state)
+
+
 def put_batch(trainer: SpmdTrainer, batch: Dict[str, np.ndarray]):
     """Host batch -> sharded device arrays matching the trainer layout."""
     return {k: jax.device_put(v, trainer.batch_shardings[k])

@@ -292,7 +292,39 @@ class TrialRunner:
             except Exception:
                 ckpt = None
         if ckpt is not None:
+            ckpt = self._persist_checkpoint(trial, ckpt, result)
             trial.ckpt_manager.add(ckpt, result)
+
+    def _persist_checkpoint(self, trial: Trial, ckpt, result: Dict):
+        """Route trial checkpoints through the durable engine
+        (<logdir>/checkpoints, atomic commit): a driver crash between
+        result rounds can no longer lose every checkpoint with the
+        process. Disk retention by recency only applies when no metric is
+        set — score-based top-K stays the in-memory manager's call.
+        RTPU_TUNE_DISK_CKPT=0 restores the in-memory-only behavior."""
+        if trial.logdir is None or \
+                os.environ.get("RTPU_TUNE_DISK_CKPT", "1") == "0":
+            return ckpt
+        try:
+            from ray_tpu.checkpoint import CheckpointManager
+            mgr = getattr(trial, "_disk_ckpt_mgr", None)
+            if mgr is None:
+                mgr = CheckpointManager(
+                    os.path.join(trial.logdir, "checkpoints"),
+                    num_to_keep=(self.num_to_keep
+                                 if self.metric is None else None))
+                trial._disk_ckpt_mgr = mgr
+            latest = mgr.latest_committed()
+            step = max(result.get(TRAINING_ITERATION, 0),
+                       (latest + 1) if latest is not None else 0)
+            mgr.stage(step, ckpt)
+            mgr.commit_step(step)
+            return mgr.load(step)
+        except Exception as e:  # noqa: BLE001 — durability is best-effort
+            # here; the in-band payload still reaches the in-memory manager
+            logger.warning("trial %s: disk checkpoint persist failed: %r",
+                           trial.trial_id, e)
+            return ckpt
 
     def _check_setup_refs(self, trial: Trial) -> bool:
         """Surface create/restore errors once train has produced its
