@@ -613,6 +613,99 @@ def _ckpt_bench_main():
     print(json.dumps({"metric": "checkpoint", **out}), flush=True)
 
 
+def _chaos_bench_main():
+    """Chaos smoke (_BENCH_CHAOS=1): fault→detect→recover latency for
+    the two headline faults.
+
+    Phase A — worker SIGKILL at a chosen task count (chaos schedule):
+    detect = the raylet's WORKER_DIED event vs the kill timestamp in the
+    chaos log; recover = the killed task's retried result landing.
+
+    Phase B — preemption notice on a worker node: drain time (the
+    raylet's own NODE_PREEMPTED accounting) and failover time (notice →
+    GCS marks the node dead) from the structured event stream.
+
+    One JSON line; recorded in PERF.md."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private import worker as wmod
+    from ray_tpu._private.cluster_utils import Cluster
+
+    out = {}
+
+    def events(w, label):
+        evs = w.call_sync(w.gcs, "list_events", {"limit": 1000})
+        return [e for e in evs if e.get("label") == label]
+
+    # ---- phase A: worker kill detect/recover
+    log_path = os.path.join(tempfile.mkdtemp(prefix="rtpu_chaos_bench_"),
+                            "chaos.jsonl")
+    os.environ["RTPU_CHAOS"] = json.dumps({"seed": 1, "schedule": [
+        {"site": "worker.execute", "op": "kill", "at": 3,
+         "proc": "worker"}]})
+    os.environ["RTPU_CHAOS_LOG"] = log_path
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_retries=3)
+        def unit(x):
+            return x
+
+        t0 = time.perf_counter()
+        for i in range(6):
+            assert ray_tpu.get(unit.remote(i), timeout=120) == i
+        out["workload_wall_s"] = round(time.perf_counter() - t0, 3)
+        w = wmod._global_worker
+        kill = next(r for r in chaos.read_log(log_path)
+                    if r["op"] == "kill")
+        died = events(w, "WORKER_DIED")
+        assert died, "worker death was never detected"
+        out["worker_kill_detect_ms"] = round(
+            1e3 * (died[0]["timestamp"] - kill["ts"]), 1)
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RTPU_CHAOS", None)
+        os.environ.pop("RTPU_CHAOS_LOG", None)
+
+    # ---- phase B: preemption drain + failover
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        info = cluster.add_node(num_cpus=2, resources={"spot": 1})
+        cluster.connect()
+        cluster.wait_for_nodes()
+        w = wmod._global_worker
+
+        @ray_tpu.remote(max_retries=3, resources={"spot": 0.1})
+        def on_spot(x):
+            return x + 1
+
+        assert ray_tpu.get(on_spot.remote(1), timeout=60) == 2
+        t0 = time.time()
+        cluster.preempt_node(info, grace_s=2.0)
+        deadline = time.monotonic() + 30
+        dead_at = None
+        while time.monotonic() < deadline:
+            n = next(n for n in ray_tpu.nodes()
+                     if n["node_id"] == info["node_id"])
+            if not n["alive"]:
+                dead_at = time.time()
+                break
+            time.sleep(0.1)
+        assert dead_at is not None, "preempted node never died"
+        notice = events(w, "PREEMPTION_NOTICE")
+        preempted = events(w, "NODE_PREEMPTED")
+        assert notice and preempted
+        out["preempt_drain_s"] = round(
+            preempted[0]["fields"].get("drain_s", 0.0), 3)
+        out["preempt_failover_s"] = round(dead_at - t0, 3)
+        out["preempt_notice_to_dead_s"] = round(
+            dead_at - notice[0]["timestamp"], 3)
+    finally:
+        cluster.shutdown()
+    print(json.dumps({"metric": "chaos", **out}), flush=True)
+
+
 # ------------------------------------------------------- serve data-plane bench
 
 class _BenchSeqCounter:
@@ -875,6 +968,12 @@ def main():
     elif os.environ.get("_BENCH_SERVE"):
         try:
             _serve_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_CHAOS"):
+        try:
+            _chaos_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -185,6 +185,10 @@ def shutdown():
     if w is not None and w.connected:
         w.disconnect()
     _worker_mod._global_worker = None
+    # drop any driver-side chaos engine so one chaos run cannot leak
+    # faults into the next init in the same process
+    from ray_tpu._private import chaos as _chaos
+    _chaos.clear()
     if _node_processes is not None:
         _node_processes.kill_all()
         _node_processes = None

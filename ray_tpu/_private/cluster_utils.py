@@ -92,6 +92,23 @@ class Cluster:
             infos.append(info)
         return infos
 
+    def preempt_node(self, info: Dict[str, Any],
+                     grace_s: Optional[float] = None) -> Dict[str, Any]:
+        """Deliver a preemption notice to a worker node. With a custom
+        ``grace_s`` the notice rides the GCS ``preempt_node`` RPC;
+        otherwise SIGUSR2 hits the raylet directly (the spot-VM path).
+        The node drains (stops taking work, lets in-flight work finish,
+        signals trainers to checkpoint) and then exits — it is NOT
+        removed from ``worker_nodes`` here; the GCS marks it dead when
+        the drain completes."""
+        if grace_s is None:
+            node_mod.preempt_raylet(info["proc"])
+            return {"draining": True}
+        from ray_tpu._private import worker as wmod
+        w = wmod.global_worker()
+        return w.call_sync(w.gcs, "preempt_node", {
+            "node_id": info["node_id"], "grace_s": grace_s})
+
     def remove_node(self, info: Dict[str, Any], allow_graceful: bool = False):
         proc = info["proc"]
         if allow_graceful:

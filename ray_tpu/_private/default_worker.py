@@ -21,6 +21,8 @@ def main():
         atexit.register(lambda: profiler.dump_stats(os.path.join(
             os.environ["RTPU_CPROFILE_DIR"],
             f"worker_{os.getpid()}.pstats")))
+    from ray_tpu._private import chaos
+    eng = chaos.init_from_env("worker")
     from ray_tpu._private.worker import Worker, MODE_WORKER
 
     w = Worker()
@@ -39,6 +41,9 @@ def main():
     from ray_tpu.common.config import SystemConfig, set_global_config
     w.config = SystemConfig.from_json(reply["config"])
     set_global_config(w.config)
+    if eng is not None:
+        eng.set_notifier(
+            lambda ev: w.io.run_async(w.gcs.notify("add_event", ev)))
     w.task_execution_loop()
 
 

@@ -62,6 +62,10 @@ class NodeInfo:
         self.tpu: Dict[str, Any] = dict(payload.get("tpu", {}))
         self.conn = conn
         self.alive = True
+        # draining: preemption notice received; still alive (in-flight
+        # work finishing) but the scheduler must not place onto it
+        self.draining = False
+        self.drain_deadline_unix = 0.0
         self.last_seen = time.monotonic()
         self.is_head = bool(payload.get("is_head"))
         # versioned sync state (reference: ray_syncer.h — per-node
@@ -119,6 +123,9 @@ class GcsServer:
             "profile_flamegraph": self.profile_flamegraph,
             "get_node_stats": self.get_node_stats,
             "drain_node": self.drain_node,
+            "node_draining": self.node_draining,
+            "node_drained": self.node_drained,
+            "preempt_node": self.preempt_node,
             "kv_put": self.kv_put,
             "kv_get": self.kv_get,
             "kv_del": self.kv_del,
@@ -327,6 +334,7 @@ class GcsServer:
         delta = [{
             "node_id": n.node_id,
             "alive": n.alive,
+            "draining": n.draining,
             "raylet_address": n.raylet_address,
             "available": n.available_resources,
             "total": n.total_resources,
@@ -397,6 +405,7 @@ class GcsServer:
         return [{
             "node_id": n.node_id,
             "alive": n.alive,
+            "draining": n.draining,
             "raylet_address": n.raylet_address,
             "object_store_path": n.object_store_path,
             "resources": n.total_resources,
@@ -459,6 +468,51 @@ class GcsServer:
     async def drain_node(self, payload, conn):
         await self._mark_node_dead(payload["node_id"], "drained")
         return {}
+
+    async def node_draining(self, payload, conn):
+        """A raylet received a preemption notice: mark it draining in
+        the node table so the scheduler (spillback, actors, PGs) stops
+        placing onto it, and broadcast for anyone watching node state."""
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {}
+        node.draining = True
+        node.drain_deadline_unix = float(
+            payload.get("deadline_unix") or 0.0)
+        self._bump_view(node)
+        self._event("WARNING", "NODE_DRAINING",
+                    f"node {node.node_id[:8]} draining: "
+                    f"{payload.get('reason') or 'preemption notice'}",
+                    node_id=node.node_id,
+                    grace_s=payload.get("grace_s"),
+                    deadline_unix=node.drain_deadline_unix)
+        await self._publish("node_events", {
+            "event": "draining", "node_id": node.node_id,
+            "grace_s": payload.get("grace_s"),
+            "deadline_unix": node.drain_deadline_unix})
+        return {}
+
+    async def node_drained(self, payload, conn):
+        """Graceful end of a drain: the raylet is about to exit — mark
+        the node dead NOW (fast failover) instead of waiting out the
+        heartbeat timeout."""
+        await self._mark_node_dead(
+            payload["node_id"],
+            f"preempted ({payload.get('reason') or 'drained'})")
+        return {}
+
+    async def preempt_node(self, payload, conn):
+        """Deliver a preemption notice to a raylet (the test/operator
+        entry; real TPU spot notices arrive as SIGUSR2 on the host)."""
+        node = self.nodes.get(payload["node_id"])
+        if node is None or not node.alive:
+            return {"error": "unknown or dead node"}
+        try:
+            return await node.conn.call("preempt", {
+                "grace_s": payload.get("grace_s"),
+                "reason": payload.get("reason")}, timeout=10)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
     async def cluster_resources(self, payload, conn):
         out: Dict[str, float] = {}
@@ -802,7 +856,7 @@ class GcsServer:
 
     def _feasible(self, node: NodeInfo, demand: Dict[str, float],
                   strict_labels: Dict[str, str] | None = None) -> bool:
-        if not node.alive:
+        if not node.alive or node.draining:
             return False
         for k, v in (strict_labels or {}).items():
             if node.labels.get(k) != v and str(node.tpu.get(k)) != str(v):
@@ -986,7 +1040,7 @@ class GcsServer:
         (Pending PGs demanding more than any node will ever have back
         off hard instead of re-running placement every interval.)"""
         totals = [dict(n.total_resources) for n in self.nodes.values()
-                  if n.alive]
+                  if n.alive and not n.draining]
         for b in bundles:
             if not any(all(t.get(k, 0) >= v for k, v in b.items())
                        for t in totals):
@@ -1027,7 +1081,7 @@ class GcsServer:
         # creations doesn't stampede one node on stale reports
         avail = {}
         for nid, n in self.nodes.items():
-            if not n.alive:
+            if not n.alive or n.draining:
                 continue
             pending = self._pending_for(nid)
             avail[nid] = {

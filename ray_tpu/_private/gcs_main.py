@@ -21,7 +21,11 @@ async def main():
         os.path.join(session_dir, "gcs_store")
     from ray_tpu.util import events
     events.init_emitter("gcs", session_dir)
+    from ray_tpu._private import chaos
+    eng = chaos.init_from_env("gcs")
     gcs = GcsServer(config, store_path=store_dir)
+    if eng is not None:
+        eng.set_notifier(gcs.events.append)
     actual = await gcs.start("127.0.0.1", port)
     tmp = os.path.join(session_dir, ".gcs_port.tmp")
     with open(tmp, "w") as f:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -195,6 +196,21 @@ def start_head(config: SystemConfig,
     np_.raylet_address = info["unix_address"]
     np_.store_path = info["store_path"]
     return np_
+
+
+def preempt_raylet(proc: subprocess.Popen) -> bool:
+    """Deliver a preemption notice to a raylet process the way a TPU
+    spot/maintenance notice reaches the host: SIGUSR2. The raylet drains
+    gracefully for its configured grace window (see
+    raylet._preempt_drain), then exits — unlike ``kill_all``, which
+    models an unannounced death. Returns False if the process is gone."""
+    if proc is None or proc.poll() is not None:
+        return False
+    try:
+        proc.send_signal(signal.SIGUSR2)
+        return True
+    except OSError:
+        return False
 
 
 def add_node(session_dir: str, gcs_address: str,

@@ -25,6 +25,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from ray_tpu._private import chaos
+
 REQUEST, REPLY, ERROR, NOTIFY = 0, 1, 2, 3
 
 _MAX_FRAME = 256 * 1024 * 1024
@@ -87,6 +89,27 @@ class Connection:
             while True:
                 frame = await read_frame(self.reader)
                 mtype, seq, method, payload = frame
+                eng = chaos._ENGINE
+                if eng is not None and mtype in (REQUEST, NOTIFY):
+                    # chaos injection point (inbound): drop/delay/dup a
+                    # frame or reset the link — restricted to
+                    # request/notify frames (swallowing a reply wedges
+                    # the peer's pending future; model that by dropping
+                    # the reply on ITS send side instead)
+                    act = eng.hit("protocol.recv", method)
+                    if act is not None:
+                        op = act["op"]
+                        if op == "drop":
+                            continue
+                        if op == "delay":
+                            await asyncio.sleep(
+                                float(act.get("delay_s", eng.delay_s)))
+                        elif op == "reset":
+                            raise ConnectionError("chaos: reset (recv)")
+                        elif op == "dup":
+                            spawn(self._dispatch(
+                                seq if mtype == REQUEST else None,
+                                method, payload))
                 if mtype == REQUEST:
                     spawn(self._dispatch(seq, method, payload))
                 elif mtype == NOTIFY:
@@ -127,8 +150,27 @@ class Connection:
                     pass
 
     async def _send(self, body):
+        dup = False
+        eng = chaos._ENGINE
+        if eng is not None:
+            # chaos injection point (outbound): body[2] is the method
+            act = eng.hit("protocol.send", body[2])
+            if act is not None:
+                op = act["op"]
+                if op == "drop":
+                    return
+                if op == "delay":
+                    await asyncio.sleep(
+                        float(act.get("delay_s", eng.delay_s)))
+                elif op == "reset":
+                    self.close()
+                    raise ConnectionError("chaos: reset (send)")
+                elif op == "dup":
+                    dup = True
         async with self._send_lock:
             self.writer.write(pack_frame(body))
+            if dup:
+                self.writer.write(pack_frame(body))
             await self.writer.drain()
 
     async def call(self, method: str, payload: Any = None,
@@ -174,7 +216,12 @@ class Connection:
                 await task
             except asyncio.CancelledError:
                 cur = asyncio.current_task()
-                if cur is not None and cur.cancelling():
+                # Task.cancelling() is 3.11+; on 3.10 there is no way to
+                # tell "our cancellation" from the read loop's — swallow,
+                # matching pre-3.11 semantics (the read loop's cancel is
+                # the overwhelmingly common case here)
+                if cur is not None and \
+                        getattr(cur, "cancelling", lambda: 0)():
                     raise  # OUR cancellation, not the read loop's
             except Exception:  # noqa: BLE001 — read-loop teardown errors
                 pass
@@ -202,11 +249,25 @@ class Server:
             spawn(cb(conn))
 
     async def _handle(self, method, payload, conn):
+        if chaos._ENGINE is not None:
+            # chaos injection point: "kill" at the N-th served request
+            # (executed inside the engine — SIGKILL, no cleanup)
+            chaos.hit("rpc.request", method)
         if method == "__hello__":
             # version negotiation (schema.py — the protobuf-package
             # role): reply with our version + schema hash; reject
             # incompatible majors so drift fails at connect, not mid-RPC
             from ray_tpu._private import schema
+            ver = (payload or {}).get("protocol_version")
+            if isinstance(ver, (list, tuple)) and len(ver) == 2:
+                try:
+                    # remember what the peer negotiated: handlers gate
+                    # minor-version features (e.g. batched dispatch
+                    # statuses) on this instead of assuming the newest
+                    conn.meta["peer_protocol_version"] = (
+                        int(ver[0]), int(ver[1]))
+                except (TypeError, ValueError):
+                    pass
             err = schema.check_hello(payload or {})
             if err:
                 raise RpcError(f"protocol negotiation failed: {err}")
@@ -532,10 +593,27 @@ class EventLoopThread:
                 await asyncio.gather(*tasks, return_exceptions=True)
             self.loop.stop()
 
+        coro = _drain()
+        if not self._thread.is_alive():
+            # the loop thread already exited (loop crashed or stopped):
+            # scheduling the drain would park the coroutine forever on a
+            # dead loop — never awaited, flagged at GC. Close it unrun
+            # and finish the loop teardown directly.
+            coro.close()
+            if not self.loop.is_closed():
+                self.loop.close()
+            return
         try:
-            asyncio.run_coroutine_threadsafe(_drain(), self.loop)
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
         except RuntimeError:
-            return  # loop already stopped/closed
+            # loop stopped/closed between the aliveness check and the
+            # schedule: close the never-started coroutine so the
+            # conftest leak gate stays clean
+            coro.close()
+            self._thread.join(timeout=5)
+            if not self._thread.is_alive() and not self.loop.is_closed():
+                self.loop.close()
+            return
         self._thread.join(timeout=5)
         if not self._thread.is_alive() and not self.loop.is_closed():
             self.loop.close()

@@ -42,9 +42,10 @@ import subprocess
 import sys
 import tempfile
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import protocol
+from ray_tpu._private import chaos, protocol
 from ray_tpu._private.object_store import PlasmaxStore
 from ray_tpu._private.sched import PendingTask, bundle_key_of, make_ledger
 from ray_tpu.exceptions import ObjectStoreFullError
@@ -315,6 +316,14 @@ class Raylet:
         self._lease_counter = 0
         self._last_lease_revoke = 0.0
         self._lease_owner_conns: Dict[str, Any] = {}
+        # leases revoked but not yet drain-acked by their owner
+        # (release_lease carrying inflight=0); value = revoke time
+        self._revoking_leases: Dict[str, float] = {}
+        # preemption drain state (TPU spot semantics): draining refuses
+        # new work, lets in-flight work finish inside the grace window,
+        # then the process exits like the preempted host it models
+        self._draining = False
+        self._drain_deadline_unix = 0.0
         self.gcs: Optional[protocol.Connection] = None
         self.server = protocol.Server(self._handlers())
         self.address = ""
@@ -358,6 +367,7 @@ class Raylet:
             "lease_worker": self.handle_lease_worker,
             "release_lease": self.handle_release_lease,
             "task_stats": self.handle_task_stats,
+            "preempt": self.handle_preempt,
             "_on_disconnect": self._on_disconnect,
         }
 
@@ -430,7 +440,10 @@ class Raylet:
         return await fn(payload, conn)
 
     async def _on_disconnect(self, conn):
-        for lease_id in conn.meta.get("leases", ()):
+        # snapshot: _release_lease prunes conn.meta["leases"] in place —
+        # iterating the live list skips every other lease, permanently
+        # leaking the skipped ones' ledger capacity
+        for lease_id in list(conn.meta.get("leases", ())):
             self._release_lease(lease_id)  # owner died holding leases
         # free this reader's outbound-pull serve slots: a leaked slot
         # makes an idle source answer "busy" until the stale sweep
@@ -726,6 +739,15 @@ class Raylet:
     async def handle_submit_task(self, payload, conn):
         fut = asyncio.get_running_loop().create_future()
         ptask = PendingTask(payload, fut)
+        if self._draining:
+            # a draining node accepts no new work: move it to a peer or
+            # hand the owner a retryable error (its resubmit re-enters
+            # here and spills once a peer has capacity)
+            spill = await self._try_spillback(ptask, force=True)
+            if spill is not None:
+                return spill
+            return {"error": "NODE_DRAINING",
+                    "message": "node is draining (preemption notice)"}
         if not payload.get("spilled_from") and \
                 (self._infeasible(ptask) or self._policy_routed(payload)):
             spill = await self._try_spillback(ptask, force=True)
@@ -769,17 +791,24 @@ class Raylet:
                                                    **reply})
 
             fut.add_done_callback(_on_done)
-            if self._infeasible(ptask) or spec.get("spilled_from") or \
-                    self._policy_routed(spec):
+            if self._draining or self._infeasible(ptask) or \
+                    spec.get("spilled_from") or self._policy_routed(spec):
                 # rare path: resolve off-line so the batch ack stays fast
                 async def _spill(pt=ptask):
-                    force = self._infeasible(pt) or (
+                    force = self._draining or self._infeasible(pt) or (
                         self._policy_routed(pt.spec)
                         and not pt.spec.get("spilled_from"))
                     spill = await self._try_spillback(pt, force=force)
                     if spill is not None:
                         if not pt.reply_fut.done():
                             pt.reply_fut.set_result(spill)
+                        return
+                    if self._draining:
+                        if not pt.reply_fut.done():
+                            pt.reply_fut.set_result({
+                                "error": "NODE_DRAINING",
+                                "message": "node is draining "
+                                           "(preemption notice)"})
                         return
                     self.led.append(pt)
                     self._dispatch_event.set()
@@ -851,7 +880,17 @@ class Raylet:
         can never both be judged feasible against the same availability
         and then over-subscribe (spillback probes run as side tasks)."""
         while not self._shutdown:
-            await self._dispatch_event.wait()
+            # bounded wait, not a pure event wait: a task queued here
+            # while its only feasible node was down has NO local event
+            # left to wake it when replacement capacity registers at the
+            # GCS — the periodic tick re-probes stuck classes (the
+            # spillback probe is cheap and rate-limited per class)
+            try:
+                await asyncio.wait_for(self._dispatch_event.wait(),
+                                       timeout=1.0)
+            except asyncio.TimeoutError:
+                if self.led.pending_count() == 0:
+                    continue
             self._dispatch_event.clear()
             now = time.monotonic()
             # one ledger poll atomically acquires resources for every
@@ -959,6 +998,13 @@ class Raylet:
         handle.job_id = ptask.spec.get("job_id") or handle.job_id
         handle.num_tasks += 1
         self._tasks_dispatched_total += 1
+        # chaos injection point: process faults keyed on dispatch count
+        # (kill the dispatched-to worker, kill this raylet, or deliver a
+        # preemption notice at the N-th task)
+        chaos_act = None
+        if chaos._ENGINE is not None:
+            chaos_act = chaos.hit("raylet.dispatch",
+                                  ptask.spec.get("fn_name"))
         self._running_tasks[ptask.spec["task_id"]] = (handle, ptask)
         try:
             push = {"spec": ptask.spec, "tpu_chips": list(chips)}
@@ -977,6 +1023,24 @@ class Raylet:
                 "worker_id": handle.worker_id,
                 "worker_address": handle.address,
             })
+        if chaos_act is not None:
+            self._apply_dispatch_chaos(chaos_act, handle)
+
+    def _apply_dispatch_chaos(self, act: Dict[str, Any],
+                              handle: WorkerHandle):
+        op = act.get("op")
+        if op == "kill_worker":
+            # kill AFTER the push: the task is in flight, exercising the
+            # full death path (_handle_worker_death → owner notify →
+            # retry), not just a failed dispatch
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+        elif op == "preempt":
+            grace = float(act.get("grace_s",
+                                  self.config.preemption_grace_s))
+            protocol.spawn(self._preempt_drain(grace, "chaos preemption"))
 
     # ------------------------------------------------------- worker leases
 
@@ -988,6 +1052,12 @@ class Raylet:
         released, and the raylet stays out of the per-task loop
         entirely (2 messages/task instead of 6)."""
         demand = dict(payload.get("resources") or {"CPU": 1.0})
+        if self._draining:
+            # drain semantics: a draining node grants no new leases —
+            # the owner falls back to the normal path and the GCS
+            # scheduler (which sees the draining flag) places elsewhere
+            return {"error": "LEASE_UNAVAILABLE",
+                    "message": "node is draining (preemption notice)"}
         if int(demand.get("TPU", 0) or 0):
             return {"error": "LEASE_UNSUPPORTED",
                     "message": "TPU tasks are not leasable (chips are "
@@ -1037,19 +1107,41 @@ class Raylet:
         return {}
 
     async def _revoke_lease(self, lease_id: str):
-        """Ask the owner to stop using the lease, then reclaim it.  The
-        owner's in-flight pushes finish on the worker's serial queue;
-        new tasks fall back to its normal path."""
+        """Ask the owner to stop using the lease, then reclaim it once
+        the owner acks the drain (a ``release_lease`` carrying
+        ``inflight=0``).  Releasing immediately re-idled a worker that
+        may still be executing the owner's in-flight leased tasks — the
+        next dispatch would queue behind work of unknown length on a
+        worker the ledger already counted as free.  A timer is the
+        backstop for a wedged owner; a dead owner's ``_on_disconnect``
+        releases directly."""
         conn = self._lease_owner_conns.get(lease_id)
-        if conn is not None:
+        if conn is not None and not conn._closed:
             try:
                 await conn.notify("revoke_lease", {"lease_id": lease_id})
             except Exception:
-                pass
+                self._release_lease(lease_id)
+                return
+            if lease_id in self._leases and \
+                    lease_id not in self._revoking_leases:
+                self._revoking_leases[lease_id] = time.monotonic()
+                asyncio.get_running_loop().call_later(
+                    self.config.lease_revoke_ack_timeout_s,
+                    self._force_release_revoked, lease_id)
+            return
         self._release_lease(lease_id)
+
+    def _force_release_revoked(self, lease_id: str):
+        """Revoke-ack timeout backstop: reclaim the lease anyway."""
+        if self._revoking_leases.pop(lease_id, None) is not None and \
+                lease_id in self._leases:
+            logger.warning("lease %s revoke not acked in time; "
+                           "force-releasing", lease_id)
+            self._release_lease(lease_id)
 
     def _release_lease(self, lease_id: str):
         entry = self._leases.pop(lease_id, None)
+        self._revoking_leases.pop(lease_id, None)
         owner = self._lease_owner_conns.pop(lease_id, None)
         if owner is not None:
             # prune the per-connection list — it must not grow
@@ -1076,6 +1168,109 @@ class Raylet:
                 self._lease_owner_conns.pop(lid, None)
                 self._release_resources(pt, ch)
 
+    # ------------------------------------------------------ preemption drain
+
+    async def handle_preempt(self, payload, conn):
+        """Preemption notice (TPU spot semantics): the host will be
+        reclaimed after a grace window. Delivered by the cloud control
+        plane (SIGUSR2 → raylet_main), the chaos engine, or the GCS
+        ``preempt_node`` RPC. Idempotent — the first notice starts the
+        drain; later ones report the deadline already set."""
+        payload = payload or {}
+        grace = float(payload.get("grace_s")
+                      or self.config.preemption_grace_s)
+        if not self._draining:
+            protocol.spawn(self._preempt_drain(
+                grace, payload.get("reason") or "preemption notice"))
+        return {"draining": True,
+                "deadline_unix": self._drain_deadline_unix
+                or time.time() + grace}
+
+    def preempt_from_signal(self):
+        """Thread/signal-safe entry (raylet_main wires SIGUSR2 here)."""
+        if not self._draining:
+            protocol.spawn(self._preempt_drain(
+                self.config.preemption_grace_s, "SIGUSR2 preemption signal"))
+
+    async def _preempt_drain(self, grace_s: float, reason: str):
+        """Graceful drain: stop taking work, move queued tasks to peers,
+        let in-flight tasks finish inside the grace window, give
+        trainers the chance to commit an out-of-band checkpoint, then
+        die like the preempted host this models."""
+        if self._draining:
+            return
+        self._draining = True
+        deadline = time.monotonic() + grace_s
+        self._drain_deadline_unix = time.time() + grace_s
+        t0 = time.monotonic()
+        self._event("WARNING", "PREEMPTION_NOTICE",
+                    f"node {self.node_id[:8]} preempted ({reason}): "
+                    f"draining for {grace_s:.1f}s",
+                    node_id=self.node_id, grace_s=grace_s, reason=reason,
+                    deadline_unix=self._drain_deadline_unix)
+        # 1. mark draining in the GCS node table: the cluster scheduler
+        # stops placing onto this node and peers stop spilling here
+        try:
+            await self.gcs.call("node_draining", {
+                "node_id": self.node_id, "grace_s": grace_s,
+                "deadline_unix": self._drain_deadline_unix,
+                "reason": reason}, timeout=5)
+        except Exception:
+            logger.warning("could not report draining to GCS",
+                           exc_info=True)
+        # 2. stop granting leases (handle_lease_worker gates on
+        # _draining) and revoke the ones out there — owners drain their
+        # in-flight pushes and fall back to the normal path
+        for lease_id in list(self._leases):
+            protocol.spawn(self._revoke_lease(lease_id))
+        # 3. signal local workers: trainers commit an out-of-band
+        # checkpoint through their AsyncCheckpointer before the node dies
+        # (air.session surfaces the deadline to the train loop)
+        for h in list(self.workers.values()):
+            if h.conn is not None:
+                try:
+                    await h.conn.notify("preemption_notice", {
+                        "deadline_unix": self._drain_deadline_unix,
+                        "grace_s": grace_s})
+                except Exception:
+                    pass
+        # 4. queued (undispatched) tasks can't run here any more: move
+        # them to peers, or fail them retryably so the owner resubmits
+        for pt in list(self.led.pending_tasks()):
+            self.led.remove(pt)
+            spill = None
+            try:
+                spill = await self._try_spillback(pt, force=True)
+            except Exception:
+                spill = None
+            if pt.reply_fut is not None and not pt.reply_fut.done():
+                pt.reply_fut.set_result(spill or {
+                    "error": "NODE_DRAINING",
+                    "message": "node is draining (preemption notice)"})
+        # 5. let in-flight tasks/leases finish inside the grace window
+        while time.monotonic() < deadline:
+            if not self._running_tasks and not self._leases:
+                break
+            await asyncio.sleep(0.1)
+        drained_clean = not self._running_tasks and not self._leases
+        self._event("WARNING", "NODE_PREEMPTED",
+                    f"node {self.node_id[:8]} drained in "
+                    f"{time.monotonic() - t0:.2f}s "
+                    f"({'clean' if drained_clean else 'grace expired'}); "
+                    "terminating", node_id=self.node_id,
+                    drain_s=time.monotonic() - t0, clean=drained_clean)
+        # 6. graceful goodbye: the GCS marks the node dead NOW instead of
+        # waiting out the heartbeat timeout (fast failover)
+        try:
+            await self.gcs.call("node_drained",
+                                {"node_id": self.node_id,
+                                 "reason": reason}, timeout=5)
+        except Exception:
+            pass
+        await asyncio.sleep(0.05)  # let the last notifies flush
+        self.shutdown()
+        os._exit(0)
+
     def _queue_dispatch_status(self, conn, status: Dict[str, Any]):
         """Coalesce per-task dispatch statuses into one batched notify
         per flush tick.  Failures flush immediately (retry latency);
@@ -1101,8 +1296,16 @@ class Raylet:
 
         async def _send(conn, statuses):
             try:
-                await conn.notify("task_dispatch_status_batch",
-                                  {"statuses": statuses})
+                # the coalesced batch notify is a 1.1 addition: peers
+                # that negotiated an older minor (or never sent
+                # __hello__ at all) get the per-task form they know
+                ver = conn.meta.get("peer_protocol_version")
+                if ver is not None and tuple(ver[:2]) >= (1, 1):
+                    await conn.notify("task_dispatch_status_batch",
+                                      {"statuses": statuses})
+                else:
+                    for status in statuses:
+                        await conn.notify("task_dispatch_status", status)
             except Exception:
                 pass  # owner-side on_close handles a dead conn
 
@@ -1200,6 +1403,9 @@ class Raylet:
 
     async def handle_create_actor_worker(self, payload, conn):
         """GCS asks this node to host an actor."""
+        if self._draining:
+            return {"error": "node is draining (preemption notice)",
+                    "retryable": True}
         spec = payload["create_spec"]
         demand = dict(payload.get("resources", {}))
         ptask = PendingTask({"resources": demand,
@@ -1308,6 +1514,16 @@ class Raylet:
         oid = ObjectID.from_hex(payload["object_id"])
         offset = payload.get("offset", 0)
         stream_key = (oid.hex(), id(conn))
+        corrupt = False
+        if chaos._ENGINE is not None:
+            # chaos injection point (object plane): lose or corrupt the
+            # primary copy right before serving a pull
+            act = chaos.hit("object.pull", oid.hex())
+            if act is not None:
+                if act.get("op") == "evict":
+                    await self._chaos_evict(oid)
+                    return {"found": False}
+                corrupt = act.get("op") == "corrupt"
         buf = self.store.get_buffer(oid)
         if buf is None and oid.hex() in self.spilled:
             await self._restore_spilled(oid)
@@ -1334,12 +1550,46 @@ class Raylet:
                 self._serving_pulls.pop(stream_key, None)  # last chunk
             elif total >= self.config.object_serve_tree_min_bytes:
                 self._serving_pulls[stream_key] = time.monotonic()
-            data = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: bytes(buf[offset:offset + n]))
-            return {"found": True, "total_size": total, "data": data}
+            def _read_chunk():
+                d = bytes(buf[offset:offset + n])
+                # per-chunk crc: the receiver verifies and treats a
+                # mismatch (wire/storage corruption — or chaos) as a
+                # failed replica, retrying elsewhere instead of sealing
+                # a corrupt object
+                return d, zlib.crc32(d)
+
+            data, crc = await asyncio.get_running_loop().run_in_executor(
+                None, _read_chunk)
+            if corrupt:
+                torn = bytearray(data)
+                torn[0] ^= 0xFF
+                torn[-1] ^= 0xFF
+                data = bytes(torn)
+            return {"found": True, "total_size": total, "data": data,
+                    "crc": crc}
         finally:
             buf.release()
             self.store.release(oid)
+
+    async def _chaos_evict(self, oid: ObjectID):
+        """Chaos 'evict' op: drop this node's primary copy (shm + spill)
+        and its directory entry — the fault lineage reconstruction is
+        built to recover from."""
+        hex_id = oid.hex()
+        if self.pinned.pop(hex_id, None) is not None:
+            self.store.release(oid)
+        self.store.delete(oid)
+        ent = self.spilled.pop(hex_id, None)
+        if ent is not None:
+            try:
+                self.spill_storage.delete(ent[0])
+            except Exception:
+                pass
+        try:
+            await self.gcs.call("remove_object_location", {
+                "object_id": hex_id, "node_id": self.node_id})
+        except Exception:
+            pass
 
     async def _admit_pull(self, nbytes: int):
         """Block until `nbytes` of inbound-pull budget is available
@@ -1447,6 +1697,7 @@ class Raylet:
                             continue
                         if not first.get("found"):
                             continue
+                        self._verify_chunk(first, first["data"], oid)
                         total = first["total_size"]
                         if self.store.contains(oid):
                             return
@@ -1490,6 +1741,7 @@ class Raylet:
                                         "object_id": oid.hex(), "offset": got,
                                         "length": CHUNK})
                                     d = chunk["data"]
+                                    self._verify_chunk(chunk, d, oid)
                                     await loop_.run_in_executor(
                                         None, _write, got, d)
                                     got += len(d)
@@ -1536,6 +1788,18 @@ class Raylet:
                 break
         raise RuntimeError(f"could not fetch {oid}: no live copies "
                            f"({last_err})")
+
+    @staticmethod
+    def _verify_chunk(reply: Dict[str, Any], data, oid: ObjectID):
+        """End-to-end pull integrity: a chunk whose crc32 doesn't match
+        what the sender computed is a failed replica (wire/storage
+        corruption), not data — raise so the fetch loop retries against
+        another copy instead of sealing a corrupt object. Replies from
+        pre-1.2 peers carry no crc and pass through unchecked."""
+        crc = reply.get("crc")
+        if crc is not None and zlib.crc32(bytes(data)) != crc:
+            raise IOError(
+                f"pull chunk of {oid.hex()[:16]} failed crc verification")
 
     # -------------------------------------------------------- push manager
 

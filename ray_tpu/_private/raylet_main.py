@@ -34,6 +34,9 @@ async def main():
     from ray_tpu.util import events
     events.init_emitter("raylet", session_dir)
     node_id = os.environ["RTPU_NODE_ID"]
+    from ray_tpu._private import chaos
+    chaos.init_from_env("raylet",
+                        is_head=os.environ.get("RTPU_IS_HEAD") == "1")
     raylet = Raylet(
         config=SystemConfig().apply_env_overrides(),
         node_id=node_id,
@@ -61,6 +64,26 @@ async def main():
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
+    # SIGUSR2 = preemption notice (how a TPU spot/maintenance notice
+    # reaches the host agent): graceful drain inside the grace window
+    # instead of vanishing — see raylet._preempt_drain.
+    loop.add_signal_handler(signal.SIGUSR2, raylet.preempt_from_signal)
+    eng = chaos.engine()
+    if eng is not None:
+        # chaos faults land in the GCS event ring so fault→detect→
+        # recover latency is measurable from one stream
+        from ray_tpu._private import protocol
+
+        def _ship_chaos_event(ev):
+            def _go():
+                try:
+                    if raylet.gcs is not None:
+                        protocol.spawn(raylet.gcs.notify("add_event", ev))
+                except Exception:
+                    pass
+            loop.call_soon_threadsafe(_go)
+
+        eng.set_notifier(_ship_chaos_event)
     await stop.wait()
     raylet.shutdown()
 

@@ -32,7 +32,12 @@ from typing import Any, Dict, List, Optional, Tuple
 # 1.1: leases (lease_worker/release_lease/revoke_lease/leased_task),
 #      coalesced dispatch statuses, task_stats, profile_worker(s),
 #      worker-lifecycle methods joined the schema table.
-PROTOCOL_VERSION = (1, 1)
+#      task_dispatch_status_batch is gated on the peer having negotiated
+#      >= 1.1 via __hello__; legacy peers get per-task statuses.
+# 1.2: preemption drain (preempt/preempt_node/node_draining/
+#      node_drained/preemption_notice), release_lease.inflight
+#      revoke-drain ack, per-chunk crc on pull_object replies.
+PROTOCOL_VERSION = (1, 2)
 
 _str = str
 _num = numbers.Number
@@ -72,6 +77,16 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "known_view": (_int, False),
     },
     "drain_node": {"node_id": (_str, True)},
+    # preemption drain (1.2): notice delivery + node-table state
+    "preempt_node": {"node_id": (_str, True), "grace_s": (_num, False),
+                     "reason": (_str, False)},
+    "preempt": {"grace_s": (_num, False), "reason": (_str, False)},
+    "node_draining": {"node_id": (_str, True), "grace_s": (_num, False),
+                      "deadline_unix": (_num, False),
+                      "reason": (_str, False)},
+    "node_drained": {"node_id": (_str, True), "reason": (_str, False)},
+    "preemption_notice": {"deadline_unix": (_num, False),
+                          "grace_s": (_num, False)},
     "get_node_stats": {"node_id": (_str, False)},
     "profile_stacks": {"node_id": (_str, False),
                        "worker_id": (_str, False)},
@@ -147,9 +162,13 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     },
     "submit_task_batch": {"specs": (_list, True)},
     "task_dispatch_status_batch": {"statuses": (_list, True)},
+    "task_dispatch_status": {"task_id": (_str, True)},
     "task_done": {"task_id": (_str, True)},
     "lease_worker": {"resources": (_dict, False)},
-    "release_lease": {"lease_id": (_str, True)},
+    # inflight (1.2): 0 acks a revoke-drain — the raylet defers
+    # re-idling the leased worker until this arrives
+    "release_lease": {"lease_id": (_str, True),
+                      "inflight": (_int, False)},
     "revoke_lease": {"lease_id": (_str, True)},
     "task_stats": {"executed": (_int, True)},
     "leased_task": {"spec": (_dict, True)},

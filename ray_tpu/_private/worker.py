@@ -35,7 +35,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import chaos, protocol, serialization
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.object_store import MemoryStore, PlasmaxStore
 from ray_tpu.common.config import SystemConfig, global_config, set_global_config
@@ -370,7 +370,7 @@ class _LeaseState:
     serially at the worker, which is correct, only slower."""
 
     __slots__ = ("key", "lease_id", "addr", "inflight", "last_used",
-                 "acquiring")
+                 "acquiring", "revoked")
 
     # pipeline depth per leased worker: execution is serial, so this
     # just hides the RPC round-trip, it does not add parallelism
@@ -383,6 +383,7 @@ class _LeaseState:
         self.inflight = 0
         self.last_used = 0.0
         self.acquiring = True  # constructed on the way to acquisition
+        self.revoked = False   # raylet revoked; ack once inflight drains
 
 
 class Worker:
@@ -496,7 +497,18 @@ class Worker:
             self.raylet = self.io.run(protocol.connect(
                 raylet_address, handler=self._handle_request,
                 on_close=on_close))
+            # negotiate on the long-lived raylet link: the raylet gates
+            # minor-version features (batched dispatch statuses) on the
+            # version we declare here; a pre-hello raylet answers "no
+            # such method", which is fine — we just look legacy to it
+            try:
+                from ray_tpu._private import schema
+                self.io.run(self.raylet.call(
+                    "__hello__", schema.hello_payload(), timeout=10))
+            except Exception:
+                pass
         if mode == MODE_DRIVER:
+            chaos.init_from_env("driver")
             r = self.io.run(self.gcs.call("next_job_id", {}))
             self.job_id = JobID.from_int(r["job_index"])
             self.io.run(self.gcs.call("add_job", {
@@ -560,6 +572,7 @@ class Worker:
             "borrow_add": self._h_borrow_add,
             "borrow_del": self._h_borrow_del,
             "exit_worker": self._h_exit_worker,
+            "preemption_notice": self._h_preemption_notice,
             "ping": self._h_ping,
             "pubsub": self._h_pubsub,
             "dump_stacks": self._h_dump_stacks,
@@ -1375,6 +1388,8 @@ class Worker:
             return
         L.inflight -= 1
         L.last_used = time.monotonic()
+        if L.revoked and L.inflight == 0:
+            self._ack_revoked_lease(L)
         self._drain_lease_waiters(L.key)
         await self._h_task_result(reply, None)
 
@@ -1424,16 +1439,42 @@ class Worker:
 
     async def _h_revoke_lease(self, payload, conn):
         """The raylet reclaims a lease under contention: stop routing new
-        tasks through it (in-flight calls finish on the worker's serial
-        queue) and back off before re-acquiring."""
+        tasks through it, let in-flight calls finish on the worker's
+        serial queue, then ACK the drain with a release_lease carrying
+        ``inflight=0`` — the raylet defers re-idling the worker until
+        that ack, so it never hands the dispatch loop a worker that is
+        still executing our leased tasks."""
         lease_id = payload.get("lease_id")
         for pool in self._worker_leases.values():
             for L in list(pool):
                 if L.lease_id == lease_id:
                     self._lease_fail_at[L.key] = time.monotonic()
-                    self._drop_lease(L)  # raylet already released it
+                    L.revoked = True
+                    if L in pool:
+                        pool.remove(L)
+                    L.addr = None  # stop routing; in-flight calls
+                    # already hold their worker connection
+                    self._drain_lease_waiters(L.key)
+                    if L.inflight == 0:
+                        self._ack_revoked_lease(L)
                     return {}
         return {}
+
+    def _ack_revoked_lease(self, L):
+        """io thread: the revoked lease's in-flight calls drained —
+        tell the raylet (inflight=0) so it reclaims the worker."""
+        lease_id, L.lease_id = L.lease_id, None
+        if lease_id is None:
+            return
+
+        async def _rel():
+            try:
+                await self.raylet.call("release_lease",
+                                       {"lease_id": lease_id,
+                                        "inflight": 0})
+            except Exception:
+                pass  # raylet-side revoke-ack timeout is the backstop
+        protocol.spawn(_rel())
 
     async def _h_task_dispatch_status_batch(self, payload, conn):
         """Coalesced form: one notify carrying many statuses (the raylet
@@ -1461,13 +1502,19 @@ class Worker:
             state.worker_address = reply.get("worker_address")
             return
         if err in ("WORKER_DIED", "WORKER_START_FAILED",
-                   "OBJECT_FETCH_FAILED", "RAYLET_UNREACHABLE") and \
+                   "OBJECT_FETCH_FAILED", "RAYLET_UNREACHABLE",
+                   "NODE_DRAINING") and \
                 state.retries_left != 0:
             state.retries_left -= 1
             logger.warning("task %s failed (%s), retrying (%d left)",
                            state.spec["fn_name"], err, state.retries_left)
 
             async def _resub():
+                if err == "NODE_DRAINING":
+                    # the draining raylet spills the resubmit to a peer;
+                    # a beat of backoff keeps retries from burning out
+                    # before peer capacity shows up in the scheduler
+                    await asyncio.sleep(0.25)
                 try:
                     reply = await self.raylet.call("submit_task", state.spec)
                 except Exception as e:
@@ -1654,6 +1701,16 @@ class Worker:
     async def _h_exit_worker(self, payload, conn):
         os._exit(0)
 
+    async def _h_preemption_notice(self, payload, conn):
+        """The raylet is draining (TPU preemption): surface the deadline
+        to any train session in this process so the train loop commits
+        an out-of-band checkpoint before the node dies."""
+        from ray_tpu.air import session as air_session
+        air_session.mark_preempted(
+            deadline_unix=payload.get("deadline_unix"),
+            grace_s=payload.get("grace_s"))
+        return {}
+
     # ----------------------------------------------------- task execution side
 
     async def _h_push_task(self, payload, conn):
@@ -1700,6 +1757,11 @@ class Worker:
                                reply=item.get("reply"))
 
     def _execute_task(self, spec, tpu_chips, reply=None):
+        if chaos._ENGINE is not None:
+            # chaos injection point: "kill" at the N-th task this worker
+            # starts executing (SIGKILL — the task dies mid-flight and
+            # the owner's retry machinery takes over)
+            chaos.hit("worker.execute", spec.get("fn_name"))
         task_hex = spec["task_id"]
         self.current_task_id = TaskID(bytes.fromhex(task_hex))
         self.tpu_chips = tpu_chips

@@ -46,6 +46,45 @@ class _Session:
 
 _tls = threading.local()
 
+# Preemption state is PROCESS-global, not session-local: the raylet's
+# preemption_notice lands on the worker's io thread while the train_func
+# runs on its own thread — a thread-local could never cross that gap.
+_preempt_lock = threading.Lock()
+_preempt_state: Dict[str, Any] = {"deadline_unix": None, "grace_s": None}
+_preempt_event = threading.Event()
+
+
+def mark_preempted(deadline_unix: Optional[float] = None,
+                   grace_s: Optional[float] = None):
+    """Record a preemption notice for this process (called by the worker
+    runtime when the raylet starts draining)."""
+    with _preempt_lock:
+        _preempt_state["deadline_unix"] = deadline_unix
+        _preempt_state["grace_s"] = grace_s
+    _preempt_event.set()
+
+
+def preempted() -> bool:
+    """True once this process received a preemption notice. Train loops
+    poll this each step and commit an out-of-band checkpoint (via
+    ``get_async_checkpointer()`` + ``report``) inside the grace window."""
+    return _preempt_event.is_set()
+
+
+def preemption_deadline() -> Optional[float]:
+    """Unix time the node dies (None when not preempted / not given)."""
+    with _preempt_lock:
+        return _preempt_state["deadline_unix"]
+
+
+def _clear_preempted():
+    """Test/restart hook: a fresh worker process starts unpreempted;
+    this resets the flag for in-process reuse."""
+    with _preempt_lock:
+        _preempt_state["deadline_unix"] = None
+        _preempt_state["grace_s"] = None
+    _preempt_event.clear()
+
 
 def _set_session(s: Optional[_Session]):
     _tls.session = s

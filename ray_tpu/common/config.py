@@ -81,6 +81,12 @@ class SystemConfig:
     # lag beyond this is treated as dead (wedged loop = dead node; busy
     # loop = alive). See raylet._start_liveness_thread.
     loop_stall_death_s: float = 60.0
+    # default preemption grace window (TPU spot semantics: notice →
+    # drain → host reclaim); a notice may carry its own grace_s
+    preemption_grace_s: float = 10.0
+    # how long a revoked lease waits for the owner's drain ack
+    # (release_lease with inflight=0) before being force-reclaimed
+    lease_revoke_ack_timeout_s: float = 5.0
     # ---- control plane ----
     gcs_port: int = 0  # 0 = auto
     rpc_connect_timeout_s: float = 10.0

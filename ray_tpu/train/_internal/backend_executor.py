@@ -31,12 +31,22 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self):
-        self.worker_group = WorkerGroup(
-            num_workers=self.scaling.num_workers,
-            resources_per_worker=self.scaling.worker_resources(),
-            placement_strategy=self.scaling.placement_strategy,
-            tpu_topology=self.scaling.tpu_topology)
-        self._setup_backend()
+        try:
+            self.worker_group = WorkerGroup(
+                num_workers=self.scaling.num_workers,
+                resources_per_worker=self.scaling.worker_resources(),
+                placement_strategy=self.scaling.placement_strategy,
+                tpu_topology=self.scaling.tpu_topology)
+            self._setup_backend()
+        except TrainingFailedError:
+            raise
+        except Exception as e:
+            # gang setup rides cluster state (PG placement, actor
+            # creation): capacity lost to a preempted/killed node must
+            # surface as a retryable training failure so the trainer's
+            # failure policy gang-restarts, not as a raw crash of fit()
+            raise TrainingFailedError(
+                f"gang setup failed: {type(e).__name__}: {e}") from e
 
     def _setup_backend(self):
         wg = self.worker_group
@@ -117,8 +127,17 @@ class BackendExecutor:
                         for r in results]
             finished = 0
             for i in pending:
-                r = ray_tpu.get(
-                    wg.workers[i].get_next_result.remote(2.0), timeout=60)
+                try:
+                    r = ray_tpu.get(
+                        wg.workers[i].get_next_result.remote(2.0),
+                        timeout=60)
+                except Exception as e:
+                    # a dead gang member (preempted node, killed actor)
+                    # fails the round retryably — gang semantics, same
+                    # as a worker-reported error
+                    raise TrainingFailedError(
+                        f"worker {i} unreachable: "
+                        f"{type(e).__name__}: {e}") from e
                 if r["status"] == "result":
                     results[i] = r
                 elif r["status"] == "error":

@@ -23,6 +23,22 @@ from ray_tpu.train._internal.backend_executor import (BackendExecutor,
 logger = logging.getLogger(__name__)
 
 
+def _emit_train_event(severity: str, label: str, message: str, **fields):
+    """Structured train-lifecycle event → local JSONL + GCS event ring.
+    Gang restarts land in the same stream as PREEMPTION_NOTICE /
+    NODE_DRAINING / WORKER_DIED, so recovery latency (fault → detect →
+    resume) is measurable end to end from one event log."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.util import events as ev
+        w = global_worker()
+        ev.report(severity, label, message,
+                  gcs_notify=lambda m, p: w.io.run_async(
+                      w.gcs.notify(m, p)), **fields)
+    except Exception:
+        pass
+
+
 @dataclass
 class Result:
     """Reference analogue: ray.air.Result."""
@@ -138,15 +154,18 @@ class DataParallelTrainer(BaseTrainer):
                 checkpoint = manager.load(latest)
                 logger.info("resuming from committed checkpoint step %d",
                             latest)
+        attempt = 0
         while True:
             try:
                 return self._run_once(checkpoint, report_through_session,
-                                      manager)
+                                      manager, is_restart=attempt > 0)
             except TrainingFailedError as e:
                 logger.warning("training attempt failed: %s", e)
                 if not infinite and attempts_left <= 0:
                     return Result(error=str(e), checkpoint=checkpoint)
                 attempts_left -= 1
+                attempt += 1
+                latest = None
                 if manager is not None:
                     # a worker that died mid-save leaves an uncommitted
                     # tmp/step dir — latest_committed() skips it, so the
@@ -157,12 +176,18 @@ class DataParallelTrainer(BaseTrainer):
                                   else self.resume_from_checkpoint)
                 else:
                     checkpoint = self._latest_checkpoint or checkpoint
+                _emit_train_event(
+                    "WARNING", "TRAIN_GANG_RESTART",
+                    f"gang restart (attempt {attempt}) from committed "
+                    f"step {latest}: {e}",
+                    attempt=attempt, resumed_step=latest,
+                    run_name=self.run_config.name or "")
                 logger.warning(
                     "restarting gang from last checkpoint (%s retries left)",
                     "inf" if infinite else attempts_left)
 
     def _run_once(self, checkpoint, report_through_session: bool,
-                  manager=None) -> Result:
+                  manager=None, is_restart: bool = False) -> Result:
         from ray_tpu.air import session as air_session
         executor = BackendExecutor(self.scaling_config, self.backend_config)
         self._latest_checkpoint = checkpoint
@@ -187,6 +212,16 @@ class DataParallelTrainer(BaseTrainer):
             last_metrics: Dict[str, Any] = {}
             while True:
                 round_results = executor.get_next_results()
+                if is_restart:
+                    # first round after a gang restart: the run is live
+                    # again — this event closes the recovery window that
+                    # opened at the fault (PREEMPTION_NOTICE/WORKER_DIED)
+                    is_restart = False
+                    _emit_train_event(
+                        "INFO", "TRAIN_RESUMED",
+                        "gang resumed after restart",
+                        run_name=self.run_config.name or "",
+                        ckpt_start_step=ckpt_start_step)
                 if round_results is None:
                     break
                 rank0 = round_results[0]

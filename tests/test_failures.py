@@ -8,6 +8,7 @@ path in worker.py (_retry, _maybe_reconstruct) and the GCS actor RESTARTING
 state machine gets at least one kill-based test here.
 """
 
+import json
 import os
 import signal
 import time
@@ -18,13 +19,26 @@ import pytest
 import ray_tpu
 from ray_tpu import exceptions as exc
 
+# Background chaos for the parametrized scenarios: a fixed seed of
+# low-probability frame delays across every process. The failure paths
+# under test must hold under protocol jitter exactly as they do on a
+# quiet wire (the chaos engine replays the same jitter every run).
+_CHAOS_BG = {"seed": 77, "delay_s": 0.02,
+             "p": {"protocol.send.delay": 0.01,
+                   "protocol.recv.delay": 0.01}}
 
-@pytest.fixture(scope="function")
-def ray_4cpu():
-    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
-                       object_store_memory=128 * 1024 * 1024)
-    yield ctx
-    ray_tpu.shutdown()
+
+@pytest.fixture(scope="function", params=["quiet", "chaos-seed-77"])
+def ray_4cpu(request):
+    if request.param != "quiet":
+        os.environ["RTPU_CHAOS"] = json.dumps(_CHAOS_BG)
+    try:
+        ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                           object_store_memory=128 * 1024 * 1024)
+        yield ctx
+        ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RTPU_CHAOS", None)
 
 
 def test_task_retry_on_worker_death(ray_4cpu, tmp_path):

@@ -144,3 +144,91 @@ def test_single_flight_waiter_cancellation_propagates(io):
         return True
 
     assert io.run(scenario())
+
+
+def test_stop_after_loop_thread_exit_is_clean():
+    """stop() on an EventLoopThread whose loop thread already exited
+    must not schedule the drain onto the dead loop — the coroutine
+    would never be awaited (flagged at GC) and the loop never closed."""
+    import warnings
+
+    t = protocol.EventLoopThread(name="dead-io")
+    # simulate a crashed/early-exited loop thread
+    t.loop.call_soon_threadsafe(t.loop.stop)
+    t._thread.join(timeout=5)
+    assert not t._thread.is_alive()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)  # "never awaited"
+        t.stop()
+        gc.collect()
+    assert t.loop.is_closed()
+    t.stop()  # second call stays a no-op
+
+
+def test_hello_records_peer_version(io):
+    """__hello__ stores what the peer negotiated in conn.meta so
+    handlers can gate minor-version features on it."""
+    from ray_tpu._private import schema
+
+    async def scenario():
+        server = protocol.Server({})
+        port = await server.start_tcp("127.0.0.1", 0)
+        conn = await protocol.connect(f"127.0.0.1:{port}")
+        reply = await conn.call("__hello__", schema.hello_payload())
+        assert reply["protocol_version"] == list(schema.PROTOCOL_VERSION)
+        sconn = next(iter(server.connections))
+        assert sconn.meta["peer_protocol_version"] == \
+            schema.PROTOCOL_VERSION
+        await conn.aclose()
+        server.close()
+        return True
+
+    assert io.run(scenario())
+
+
+def test_dispatch_status_batch_gated_on_peer_minor(io):
+    """A peer that never negotiated >=1.1 gets per-task
+    task_dispatch_status notifies; a 1.1+ peer gets the coalesced
+    batch."""
+    import types
+
+    from ray_tpu._private.raylet import Raylet
+
+    async def scenario():
+        sent = []
+
+        class FakeConn:
+            def __init__(self, meta):
+                self.meta = meta
+
+            async def notify(self, method, payload):
+                sent.append((self.meta.get("tag"), method, payload))
+
+        legacy = FakeConn({"tag": "legacy"})  # no hello ever
+        old = FakeConn({"tag": "old",
+                        "peer_protocol_version": (1, 0)})
+        modern = FakeConn({"tag": "modern",
+                           "peer_protocol_version": (1, 1)})
+        fake = types.SimpleNamespace(
+            _dispatch_status_flush_scheduled=True,
+            _dispatch_status_buf={
+                1: (legacy, [{"task_id": "a"}, {"task_id": "b"}]),
+                2: (old, [{"task_id": "c"}]),
+                3: (modern, [{"task_id": "d"}, {"task_id": "e"}]),
+            })
+        Raylet._flush_dispatch_statuses(fake)
+        await asyncio.sleep(0.05)
+        by_tag = {}
+        for tag, method, payload in sent:
+            by_tag.setdefault(tag, []).append((method, payload))
+        assert by_tag["legacy"] == [
+            ("task_dispatch_status", {"task_id": "a"}),
+            ("task_dispatch_status", {"task_id": "b"})]
+        assert by_tag["old"] == [
+            ("task_dispatch_status", {"task_id": "c"})]
+        assert by_tag["modern"] == [
+            ("task_dispatch_status_batch",
+             {"statuses": [{"task_id": "d"}, {"task_id": "e"}]})]
+        return True
+
+    assert io.run(scenario())

@@ -135,3 +135,50 @@ def test_mixed_workload_not_starved_by_leases(one_cpu_cluster):
     # real (non-leased) capacity -> the raylet must revoke
     ref = other.options(scheduling_strategy="SPREAD").remote()
     assert ray_tpu.get(ref, timeout=60) == "ran"
+
+
+def test_disconnect_with_multiple_leases_refunds_all():
+    """Regression (round-5 ADVICE high-severity): an owner disconnecting
+    while holding 2+ leases must refund EVERY lease — _on_disconnect
+    used to iterate conn.meta['leases'] while _release_lease pruned it
+    in place, skipping every other entry and leaking its capacity
+    forever."""
+    import ray_tpu
+    from ray_tpu._private import protocol
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=128 * 1024 * 1024)
+    try:
+        w = _driver()
+        raylet_tcp = next(n["raylet_address"] for n in ray_tpu.nodes()
+                          if n["alive"])
+        # a second "owner": raw connection that takes 2 leases and dies
+        conn = w.io.run(protocol.connect(raylet_tcp))
+        grants = []
+        for _ in range(2):
+            r = w.call_sync(conn, "lease_worker",
+                            {"resources": {"CPU": 1.0}}, timeout=60)
+            assert "lease_id" in r, r
+            grants.append(r["lease_id"])
+        info = w.call_sync(w.raylet, "get_info", {})
+        assert info["available"].get("CPU", 0) == 0  # both CPUs leased
+        w.io.run(conn.aclose())  # owner dies holding both leases
+        deadline = time.time() + 15
+        cpu_avail = -1.0
+        while time.time() < deadline:
+            info = w.call_sync(w.raylet, "get_info", {})
+            cpu_avail = info["available"].get("CPU", 0)
+            if cpu_avail == info["resources"].get("CPU"):
+                break
+            time.sleep(0.2)
+        assert cpu_avail == info["resources"].get("CPU"), \
+            f"leaked lease capacity: available CPU {cpu_avail} after " \
+            f"owner disconnect (leases={grants})"
+        # and the refunded capacity is actually usable
+        @ray_tpu.remote(num_cpus=2)
+        def big():
+            return "ok"
+
+        assert ray_tpu.get(big.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
