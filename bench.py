@@ -879,6 +879,149 @@ def _serve_bench_main():
     print(json.dumps({"metric": "serve_dataplane", **out}), flush=True)
 
 
+# ------------------------------------------------------- serve HA bench
+
+def _serve_ha_bench_main():
+    """Serve control-plane HA benchmark (_BENCH_SERVE_HA=1): request
+    success rate and latency under sustained load during (a) a
+    health-gated rolling update and (b) a controller SIGKILL +
+    journal recovery. The acceptance bar is ZERO failed requests in
+    both windows — the data plane must not notice the control plane.
+    CPU-only; one JSON line."""
+    _force_cpu_platform()
+    import signal
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    duration = float(os.environ.get("BENCH_SERVE_HA_DURATION", 8.0))
+    clients = int(os.environ.get("BENCH_SERVE_HA_CLIENTS", 6))
+
+    def versioned(v):
+        @serve.deployment(num_replicas=2, name="HA",
+                          max_concurrent_queries=32,
+                          user_config={"v": v},
+                          graceful_shutdown_timeout_s=10.0)
+        class HA:
+            def __init__(self):
+                self.v = None
+
+            def reconfigure(self, cfg):
+                self.v = cfg["v"]
+
+            def __call__(self, x):
+                time.sleep(0.005)
+                return self.v
+
+        return HA
+
+    class _Phase:
+        """Closed-loop load whose samples are binned into named phases
+        by wall-clock markers."""
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.samples = []  # (t_done, latency_s, ok)
+            self.stop = threading.Event()
+
+        def worker(self, fn):
+            while not self.stop.is_set():
+                t0 = time.perf_counter()
+                ok = True
+                try:
+                    fn()
+                except Exception:
+                    ok = False
+                with self.lock:
+                    self.samples.append(
+                        (time.time(), time.perf_counter() - t0, ok))
+
+        def window(self, t_start, t_end):
+            with self.lock:
+                rows = [(lat, ok) for t, lat, ok in self.samples
+                        if t_start <= t <= t_end]
+            lats = [lat for lat, ok in rows if ok]
+            return {
+                "total": len(rows),
+                "failed": sum(1 for _, ok in rows if not ok),
+                "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2)
+                if lats else 0.0,
+                "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2)
+                if lats else 0.0,
+            }
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024,
+                 _system_config={"prestart_workers": False})
+    out = {"duration_s": duration, "clients": clients}
+    try:
+        h = serve.run(versioned(1).bind(), http_port=None)
+        ray_tpu.get(h.remote(0), timeout=30.0)
+        ph = _Phase()
+
+        def call():
+            ray_tpu.get(h.remote(0), timeout=30.0)
+
+        threads = [threading.Thread(target=ph.worker, args=(call,))
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        time.sleep(duration / 4)
+
+        # (a) health-gated rolling update under load
+        t0 = time.time()
+        serve.run(versioned(2).bind(), http_port=None,
+                  _blocking_timeout=120.0)
+        t1 = time.time()
+        out["rolling_s"] = round(t1 - t0, 2)
+        for k, v in ph.window(t0, t1).items():
+            out[f"rolling_{k}"] = v
+        time.sleep(duration / 4)
+
+        # (b) controller SIGKILL + journal recovery under load
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+        pid = ray_tpu.get(ctrl.get_controller_info.remote(),
+                          timeout=10.0)["pid"]
+        t2 = time.time()
+        os.kill(pid, signal.SIGKILL)
+        recovered = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                info = ray_tpu.get(ctrl.get_controller_info.remote(),
+                                   timeout=5.0)
+                st = ray_tpu.get(
+                    ctrl.get_deployment_statuses.remote(), timeout=5.0)
+                if info["pid"] != pid and info["recovered"] and \
+                        st.get("HA", {}).get("status") == "HEALTHY":
+                    recovered = time.time()
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        t3 = time.time()
+        out["ctrl_recovery_s"] = round(
+            (recovered or t3) - t2, 2)
+        out["ctrl_recovered"] = bool(recovered)
+        for k, v in ph.window(t2, t3).items():
+            out[f"ctrl_kill_{k}"] = v
+        time.sleep(duration / 4)
+        ph.stop.set()
+        for t in threads:
+            t.join()
+        whole = ph.window(0, time.time())
+        out["overall_total"] = whole["total"]
+        out["overall_failed"] = whole["failed"]
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+    print(json.dumps({"metric": "serve_ha", **out}), flush=True)
+
+
 # ----------------------------------------------------------------- supervise
 
 def _attempt(force_cpu: bool):
@@ -968,6 +1111,12 @@ def main():
     elif os.environ.get("_BENCH_SERVE"):
         try:
             _serve_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_SERVE_HA"):
+        try:
+            _serve_ha_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -39,6 +39,13 @@ Sites wired through the runtime:
     worker.execute                  kill (the executing worker, SIGKILL)
     raylet.dispatch                 kill_worker | kill | preempt
     object.pull                     evict | corrupt
+    serve.controller.tick           kill (SIGKILL the serve controller at
+                                    the N-th control-loop tick; the GCS
+                                    restarts it and it recovers from the
+                                    journal — docs/SERVE_HA.md)
+    serve.replica.request           kill (SIGKILL one serve replica at
+                                    the N-th accepted request; method
+                                    filter = deployment name)
 
 Every fired fault is appended to the chaos log (``RTPU_CHAOS_LOG`` path;
 JSONL of ``{n, site, op, method, seq, ts}`` — everything except ``ts``

@@ -128,6 +128,7 @@ class GcsServer:
             "preempt_node": self.preempt_node,
             "kv_put": self.kv_put,
             "kv_get": self.kv_get,
+            "kv_get_prefix": self.kv_get_prefix,
             "kv_del": self.kv_del,
             "kv_keys": self.kv_keys,
             "kv_exists": self.kv_exists,
@@ -406,6 +407,7 @@ class GcsServer:
             "node_id": n.node_id,
             "alive": n.alive,
             "draining": n.draining,
+            "drain_deadline_unix": n.drain_deadline_unix,
             "raylet_address": n.raylet_address,
             "object_store_path": n.object_store_path,
             "resources": n.total_resources,
@@ -564,6 +566,14 @@ class GcsServer:
     async def kv_keys(self, payload, conn):
         prefix = payload.get("prefix", "")
         return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    async def kv_get_prefix(self, payload, conn):
+        """Bulk fetch of every key under a prefix in ONE round-trip — the
+        recovery read path (e.g. a restarted serve controller loading its
+        whole journal) must not pay a kv_get per key."""
+        prefix = payload.get("prefix", "")
+        return {"items": [[k, v] for k, v in self.kv.items()
+                          if k.startswith(prefix)]}
 
     async def kv_exists(self, payload, conn):
         return {"exists": payload["key"] in self.kv}

@@ -37,7 +37,9 @@ from typing import Any, Dict, List, Optional, Tuple
 # 1.2: preemption drain (preempt/preempt_node/node_draining/
 #      node_drained/preemption_notice), release_lease.inflight
 #      revoke-drain ack, per-chunk crc on pull_object replies.
-PROTOCOL_VERSION = (1, 2)
+# 1.3: kv_get_prefix (bulk journal recovery reads — serve control-plane
+#      HA), drain_deadline_unix in get_nodes replies.
+PROTOCOL_VERSION = (1, 3)
 
 _str = str
 _num = numbers.Number
@@ -129,6 +131,7 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "kv_put": {"key": (_any, True), "value": (_any, True),
                "overwrite": (_bool, False)},
     "kv_get": {"key": (_any, True)},
+    "kv_get_prefix": {"prefix": (_any, False)},
     "kv_del": {"key": (_any, True)},
     "kv_keys": {"prefix": (_any, False)},
     "kv_exists": {"key": (_any, True)},

@@ -14,6 +14,17 @@ The replica also tracks its own load telemetry — queue depth
 controller collects into the ``replica_load`` long-poll key for
 load-aware routing and autoscaling, and which piggybacks on proxy
 responses via ``handle_request_with_load``.
+
+Graceful drain: ``prepare_drain`` flips the replica into draining mode
+— it finishes what it has but sheds every NEW arrival with a retriable
+``ReplicaOverloadedError`` (routers holding a route table published
+before the drain retry on a serving replica). The controller kills a
+draining replica only once ``queue_len`` hits zero or the deployment's
+``graceful_shutdown_timeout_s`` expires.
+
+Chaos site: ``serve.replica.request`` fires per accepted request
+(method = the deployment name), so a seeded schedule can SIGKILL one
+replica at an exact request count (``RTPU_CHAOS`` op ``kill``).
 """
 
 from __future__ import annotations
@@ -23,6 +34,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import chaos
 from ray_tpu.serve.exceptions import ReplicaOverloadedError
 
 # EWMA smoothing for per-request service time: heavy enough to damp
@@ -67,6 +79,7 @@ class ReplicaActor:
         # user code runs under this semaphore; threads past it wait in
         # the bounded "queued" room counted by admission control below
         self._exec_sem = threading.Semaphore(self._max_concurrent)
+        self._draining = False
         self._ongoing = 0
         self._queued = 0
         self._ongoing_lock = threading.Lock()
@@ -106,9 +119,20 @@ class ReplicaActor:
 
     def _execute(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         t0 = time.monotonic()
+        if chaos._ENGINE is not None:
+            # chaos injection point: "kill" at the N-th request this
+            # replica accepted (method filter = deployment name)
+            chaos.hit("serve.replica.request", self.deployment_name)
         with self._ongoing_lock:
             in_flight = self._ongoing + self._queued
             limit = self._max_concurrent + self._max_queued
+            if self._draining:
+                # a draining replica finishes what it has but takes no
+                # new work — shed retriably so the router re-routes to
+                # a replica still in the published table
+                self._total_shed += 1
+                raise ReplicaOverloadedError(self.deployment_name,
+                                             in_flight, limit)
             if in_flight >= limit:
                 self._total_shed += 1
                 raise ReplicaOverloadedError(self.deployment_name,
@@ -150,14 +174,30 @@ class ReplicaActor:
 
     def get_load(self) -> Dict[str, Any]:
         """Cheap telemetry snapshot: what the router's power-of-two-
-        choices scoring consumes (piggybacked + long-poll refreshed)."""
+        choices scoring consumes (piggybacked + long-poll refreshed),
+        and what the controller's drain poll watches reach zero."""
         with self._ongoing_lock:
             return {
                 "queue_len": self._ongoing + self._queued,
                 "ewma_s": self._ewma_s,
                 "shed": self._total_shed,
+                "draining": self._draining,
                 "ts": time.time(),
             }
+
+    def get_replica_metadata(self) -> Dict[str, Any]:
+        """Identity for controller re-adoption (orphan sweep after a
+        controller restart): which deployment + code version this
+        replica is running."""
+        return {"deployment": self.deployment_name,
+                "version": self.version}
+
+    def prepare_drain(self) -> str:
+        """Graceful-drain step 2 (step 1 removed us from the route
+        table): stop accepting new requests; in-flight ones finish."""
+        with self._ongoing_lock:
+            self._draining = True
+        return "ok"
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._ongoing_lock:

@@ -259,10 +259,20 @@ class Router:
         with self._lock:
             s = self._sets.get(deployment_name)
         if s is None:
-            # force one refresh for deployments created after seeding
-            _, snapshot = ray_tpu.get(
-                self._controller.get_route_table.remote())
-            self._on_update(snapshot)
+            # force one refresh for deployments created after seeding —
+            # resilient to a controller outage: a KNOWN deployment keeps
+            # routing from the cached set; only a genuinely unseen one
+            # needs the controller up to resolve
+            try:
+                _, snapshot = ray_tpu.get(
+                    self._controller.get_route_table.remote(),
+                    timeout=10.0)
+                self._on_update(snapshot)
+            except _REFRESH_ERRORS as e:
+                raise KeyError(
+                    f"unknown deployment {deployment_name!r} and the "
+                    f"controller is unreachable to resolve it "
+                    f"({type(e).__name__}: {e})") from e
             with self._lock:
                 s = self._sets.get(deployment_name)
         if s is None:

@@ -94,7 +94,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                route_prefix: Optional[str] = None,
-               pass_http_path: bool = False):
+               pass_http_path: bool = False,
+               graceful_shutdown_timeout_s: Optional[float] = None):
     """@serve.deployment — mark a class/function as a deployment.
 
     ``max_queued_requests`` bounds each replica's ingress waiting room
@@ -108,7 +109,13 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
     ``pass_http_path=True`` makes the HTTP proxy pass the request path
     below the route prefix as a ``__serve_path__`` kwarg — the contract
-    driver deployments (drivers.DAGDriver) use to multiplex routes."""
+    driver deployments (drivers.DAGDriver) use to multiplex routes.
+
+    ``graceful_shutdown_timeout_s`` bounds how long a replica leaving
+    service (rolling update, downscale, delete, node drain) may keep
+    finishing in-flight requests after it is removed from the route
+    table, before the controller kills it (default: env
+    ``RTPU_SERVE_GRACEFUL_SHUTDOWN_S``, else 10 s)."""
 
     def wrap(func_or_class):
         return Deployment(
@@ -123,6 +130,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 "autoscaling_config": autoscaling_config,
                 "ray_actor_options": ray_actor_options,
                 "route_prefix": route_prefix,
+                "graceful_shutdown_timeout_s": graceful_shutdown_timeout_s,
                 # @serve.ingress classes (serve/ingress.py) opt into the
                 # proxy's path+method passing via class attributes
                 "pass_http_path": pass_http_path or bool(getattr(
@@ -138,21 +146,17 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
           http_host: str = "127.0.0.1"):
     """Start (or connect to) the Serve controller; http_port=None means
     no HTTP ingress. An explicit port starts the proxy even when the
-    controller already exists."""
+    controller already exists.
+
+    The controller runs with ``max_restarts=-1``: when its worker dies,
+    the GCS restarts it and the fresh incarnation recovers target state
+    from the journal and re-adopts the live replicas (docs/SERVE_HA.md).
+    ``start`` therefore tolerates a controller that exists but is
+    mid-restart — it waits for the restarted incarnation instead of
+    racing a doomed second create against the taken name."""
     from ray_tpu._private import usage as _usage
     _usage.record_library_usage("serve")
-    controller = None
-    try:
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        ray_tpu.get(controller.ping.remote(), timeout=10.0)
-    except Exception:
-        controller = None
-    if controller is None:
-        controller_cls = ray_tpu.remote(
-            name=CONTROLLER_NAME, lifetime="detached",
-            max_concurrency=32)(ServeController)
-        controller = controller_cls.remote(http_port)
-        ray_tpu.get(controller.ping.remote(), timeout=30.0)
+    controller = _connect_controller(create=True, http_port=http_port)
     if http_port is not None:
         try:
             proxy = ray_tpu.get_actor("SERVE_PROXY")
@@ -161,11 +165,45 @@ def start(http_port: Optional[int] = _DEFAULT_HTTP_PORT,
             from ray_tpu.serve.http_proxy import HTTPProxyActor
             proxy_cls = ray_tpu.remote(
                 name="SERVE_PROXY", lifetime="detached",
+                max_restarts=-1,
                 max_concurrency=64)(HTTPProxyActor)
             proxy = proxy_cls.remote(CONTROLLER_NAME, http_host,
                                      http_port)
             ray_tpu.get(proxy.ping.remote(), timeout=30.0)
     return controller
+
+
+def _connect_controller(create: bool, http_port: Optional[int] = None,
+                        timeout: float = 30.0):
+    """Resolve a live controller handle, creating one if asked and none
+    exists. A controller in RESTARTING is waited on, not replaced."""
+    deadline = time.time() + timeout
+    last_err: Optional[Exception] = None
+    while True:
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            ray_tpu.get(controller.ping.remote(), timeout=10.0)
+            return controller
+        except Exception as e:
+            last_err = e
+        if create:
+            try:
+                controller_cls = ray_tpu.remote(
+                    name=CONTROLLER_NAME, lifetime="detached",
+                    max_restarts=-1,
+                    max_concurrency=32)(ServeController)
+                controller = controller_cls.remote(http_port)
+                ray_tpu.get(controller.ping.remote(), timeout=30.0)
+                return controller
+            except Exception as e:
+                # lost a create race or the name is held by a
+                # RESTARTING incarnation — fall through and re-resolve
+                last_err = e
+        if time.time() >= deadline:
+            raise RuntimeError(
+                f"Serve controller unavailable after {timeout}s: "
+                f"{type(last_err).__name__}: {last_err}")
+        time.sleep(0.5)
 
 
 def run(app: Union[Application, Deployment], *,
@@ -228,15 +266,49 @@ def run(app: Union[Application, Deployment], *,
     return DeploymentHandle(root_name, controller)
 
 
+def _controller_death_cause(controller) -> Optional[str]:
+    """Non-None iff the GCS says the controller actor is DEAD (not
+    merely restarting) — the caller should say so instead of timing
+    out with a generic 'not healthy' message."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        w = global_worker()
+        info = w.call_sync(w.gcs, "get_actor",
+                           {"actor_id": controller._id_hex}, timeout=10)
+        if info.get("state") == "DEAD":
+            return info.get("death_cause") or "unknown cause"
+    except Exception:
+        pass
+    return None
+
+
 def _wait_healthy(controller, names: List[str], timeout: float):
     deadline = time.time() + timeout
+    statuses: Dict[str, Any] = {}
     while time.time() < deadline:
-        statuses = ray_tpu.get(
-            controller.get_deployment_statuses.remote(), timeout=30.0)
+        try:
+            statuses = ray_tpu.get(
+                controller.get_deployment_statuses.remote(), timeout=30.0)
+        except Exception as e:
+            cause = _controller_death_cause(controller)
+            if cause is not None:
+                raise RuntimeError(
+                    f"Serve controller has died and will not restart "
+                    f"({cause}); deployments {names} cannot converge — "
+                    f"run serve.start() / serve.run() to start a new "
+                    f"controller") from e
+            # transient (controller restarting): retry until deadline
+            time.sleep(0.5)
+            continue
         if all(statuses.get(n, {}).get("status") == "HEALTHY"
                for n in names):
             return
         time.sleep(0.2)
+    cause = _controller_death_cause(controller)
+    if cause is not None:
+        raise RuntimeError(
+            f"Serve controller has died and will not restart ({cause}); "
+            f"deployments {names} cannot converge")
     raise TimeoutError(f"deployments {names} not healthy in {timeout}s: "
                        f"{statuses}")
 
@@ -299,5 +371,13 @@ def shutdown():
             pass
         time.sleep(0.5)
         ray_tpu.kill(controller)
+    except Exception:
+        pass
+    # the controller clears its journal on a clean shutdown; if it was
+    # already dead, scrub from here so a later serve.start() doesn't
+    # resurrect deployments the user just tore down
+    try:
+        from ray_tpu.serve._private import journal
+        journal.clear()
     except Exception:
         pass

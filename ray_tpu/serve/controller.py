@@ -6,16 +6,83 @@ scaling :1281, rolling updates keyed by version hash). One actor holds
 target state, reconciles replica actors toward it in a background
 thread, health-checks them, autoscales from queue metrics, and publishes
 the route table over long-poll.
+
+Control-plane HA (reference: the controller's KVStore checkpoints +
+detached-replica recovery in deployment_state.py):
+
+* **Durable state.** Every mutation of target state (deploy/delete,
+  autoscale decisions, replica membership changes) is journaled to the
+  GCS KV table (`serve/_private/journal.py`). The controller runs with
+  ``max_restarts=-1``; a restarted controller rebuilds ``_deployments``
+  from the journal, re-adopts the live detached ``SERVE_REPLICA::*``
+  actors by name (replicas are NOT restarted), republishes the route
+  table, and resumes reconciliation. Routers and the HTTP proxy keep
+  serving from their cached route tables during the outage and
+  reconnect their long-polls with backoff.
+* **Health-gated rolling updates.** Replicas on a stale version are
+  replaced start-before-stop in bounded surge waves
+  (``RTPU_SERVE_MAX_SURGE`` extra replicas at a time): each wave's new
+  replicas must pass health checks before an old replica is drained.
+  A new version that never becomes healthy leaves the old version
+  serving.
+* **Graceful drain.** A replica leaving service (rolling update,
+  downscale, deployment delete, draining node) is first removed from
+  the published route table and told to shed new arrivals
+  (``prepare_drain``), then killed only once its in-flight count hits
+  zero or ``graceful_shutdown_timeout_s`` expires.
+* **Node preemption.** Replicas on a node the GCS marks draining are
+  condemned: replacements start elsewhere first (the scheduler already
+  excludes draining nodes), then the condemned replicas drain inside
+  the node's grace window.
+
+Chaos sites (``_private/chaos.py``): ``serve.controller.tick`` fires
+once per control-loop iteration (op ``kill`` SIGKILLs the controller
+worker — the GCS restarts it); ``serve.replica.request`` lives in the
+replica (see ``_private/replica.py``).
 """
 
 from __future__ import annotations
 
 import hashlib
+import logging
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.serve.controller")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+REPLICA_NAME_PREFIX = "SERVE_REPLICA::"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _max_surge() -> int:
+    """Extra replicas a rolling update may run beyond target while a
+    wave's new replicas come up (reference: maxSurge in k8s rollouts)."""
+    return max(1, _env_int("RTPU_SERVE_MAX_SURGE", 1))
+
+
+def _health_failure_threshold() -> int:
+    return max(1, _env_int("RTPU_SERVE_HEALTH_FAILURES", 3))
+
+
+def _health_timeout_s() -> float:
+    return _env_float("RTPU_SERVE_HEALTH_TIMEOUT_S", 10.0)
 
 
 class _DeploymentInfo:
@@ -31,6 +98,17 @@ class _DeploymentInfo:
         # HEALTHY counts these, not mere creations, so serve.run cannot
         # return while replicas are still constructing
         self.ready: set = set()
+        # replica id hex -> detached actor name (journaled so a
+        # restarted controller can re-adopt by name)
+        self.replica_names: Dict[str, str] = {}
+        # handle -> {"deadline": unix, "reason": str}: out of the
+        # published route table, killed once idle or at deadline
+        self.draining: Dict[Any, Dict[str, Any]] = {}
+        # replicas on a draining node — replaced start-before-stop like
+        # stale versions, then drained inside the node's grace window
+        self.condemned: set = set()
+        # replica id hex -> consecutive failed health probes
+        self.health_fails: Dict[str, int] = {}
         self.autoscaler = None
         autoscale = config.get("autoscaling_config")
         if autoscale:
@@ -41,10 +119,27 @@ class _DeploymentInfo:
             self.target_replicas = cfg.min_replicas
             self.autoscaler = AutoscalingPolicy(cfg)
 
+    def graceful_timeout_s(self) -> float:
+        v = self.config.get("graceful_shutdown_timeout_s")
+        if v is None:
+            return _env_float("RTPU_SERVE_GRACEFUL_SHUTDOWN_S", 10.0)
+        return float(v)
+
+    def carry_over(self, prev: "_DeploymentInfo"):
+        """Redeploy: adopt the predecessor's live state (same dict
+        objects — in-flight drain polls hold references to them)."""
+        self.replicas = prev.replicas
+        self.ready = prev.ready
+        self.replica_names = prev.replica_names
+        self.draining = prev.draining
+        self.condemned = prev.condemned
+        self.health_fails = prev.health_fails
+
 
 class ServeController:
-    """Runs as a named detached actor with a high-concurrency thread
-    pool (long-poll listeners block in ``listen_for_change``)."""
+    """Runs as a named detached actor (``max_restarts=-1``) with a
+    high-concurrency thread pool (long-poll listeners block in
+    ``listen_for_change``)."""
 
     def __init__(self, http_port: Optional[int] = None):
         from ray_tpu.serve._private.long_poll import LongPollHost
@@ -52,13 +147,208 @@ class ServeController:
         self._lock = threading.RLock()
         self._long_poll = LongPollHost()
         self._replica_seq = 0
+        self._journaled_seq = -1
         self._shutdown = threading.Event()
         self._http_port = http_port
-        self._last_error: Optional[str] = None
+        self._last_error: Optional[str] = None   # control-loop level
+        self._last_errors: Dict[str, str] = {}   # per-deployment
         self._last_load_table: Dict[str, Any] = {}
+        self._last_published_table: Optional[Dict[str, Any]] = None
+        self._replica_nodes: Dict[str, str] = {}  # replica hex -> node id
+        self._draining_nodes: Dict[str, float] = {}  # node id -> deadline
+        self._recovered = False
+        self._adopted = 0
+        self._recover_from_journal()
         self._reconcile_thread = threading.Thread(
             target=self._control_loop, daemon=True)
         self._reconcile_thread.start()
+
+    # ---- journal + recovery ----
+
+    def _journal_meta(self):
+        if self._replica_seq == self._journaled_seq:
+            return
+        from ray_tpu.serve._private import journal
+        try:
+            journal.put_meta({"replica_seq": self._replica_seq,
+                              "namespace": self._namespace()})
+            self._journaled_seq = self._replica_seq
+        except Exception:
+            logger.warning("serve journal: meta write failed",
+                           exc_info=True)
+
+    def _journal_deployment(self, name: str):
+        """Write one deployment's target state + replica membership.
+        Caller holds the lock. Best-effort: a journal outage must not
+        take down serving."""
+        from ray_tpu.serve._private import journal
+        info = self._deployments.get(name)
+        try:
+            if info is None:
+                journal.delete_deployment(name)
+                return
+            journal.put_deployment(name, {
+                "config": info.config,
+                "version": info.version,
+                "target_replicas": info.target_replicas,
+                "replicas": [
+                    {"name": info.replica_names.get(h._id_hex, ""),
+                     "id": h._id_hex,
+                     "version": v,
+                     "draining": h in info.draining}
+                    for h, v in info.replicas.items()],
+            })
+        except Exception:
+            logger.warning("serve journal: write failed for %r", name,
+                           exc_info=True)
+
+    def _namespace(self) -> str:
+        try:
+            from ray_tpu._private.worker import global_worker
+            return global_worker().namespace
+        except Exception:
+            return ""
+
+    def _recover_from_journal(self):
+        """Rebuild ``_deployments`` from the GCS journal and re-adopt
+        the live detached replica actors by name — the data plane keeps
+        its processes (and its in-flight requests) across a controller
+        restart."""
+        from ray_tpu.serve._private import journal
+        try:
+            meta, deps = journal.load_all()
+        except Exception:
+            logger.warning("serve journal: recovery read failed; "
+                           "starting with empty state", exc_info=True)
+            return
+        if meta:
+            self._replica_seq = max(self._replica_seq,
+                                    int(meta.get("replica_seq", 0)))
+        if not deps:
+            return
+        ns = (meta or {}).get("namespace", self._namespace())
+        now = time.time()
+        for name, rec in deps.items():
+            try:
+                info = _DeploymentInfo(rec["config"])
+                info.target_replicas = int(
+                    rec.get("target_replicas", info.target_replicas))
+                for rep in rec.get("replicas", []):
+                    h = self._readopt_replica(rep, ns)
+                    if h is None:
+                        continue
+                    info.replicas[h] = rep.get("version", info.version)
+                    info.replica_names[h._id_hex] = rep["name"]
+                    self._bump_seq_past(rep["name"])
+                    if rep.get("draining"):
+                        # resume the interrupted drain with a fresh
+                        # grace window
+                        info.draining[h] = {
+                            "deadline": now + info.graceful_timeout_s(),
+                            "reason": "drain resumed after controller "
+                                      "restart"}
+                        try:
+                            h.prepare_drain.remote()
+                        except Exception:
+                            pass
+                    else:
+                        # it was serving a moment ago; health checks
+                        # will demote it if that changed
+                        info.ready.add(h)
+                    self._adopted += 1
+                self._deployments[name] = info
+            except Exception:
+                logger.warning("serve journal: skipping unrecoverable "
+                               "deployment %r", name, exc_info=True)
+        self._adopt_orphans(ns)
+        self._recovered = True
+        self._publish_route_table(force=True)
+        logger.info("serve controller recovered from journal: "
+                    "%d deployments, %d replicas re-adopted",
+                    len(self._deployments), self._adopted)
+
+    def _readopt_replica(self, rep: Dict[str, Any], namespace: str):
+        """Name -> live ActorHandle, or None if the replica is gone
+        (the reconcile loop will start a replacement)."""
+        name = rep.get("name")
+        if not name:
+            return None
+        try:
+            from ray_tpu._private.worker import global_worker
+            from ray_tpu.actor import ActorHandle
+            from ray_tpu.common.ids import ActorID
+            w = global_worker()
+            info = w.call_sync(w.gcs, "get_named_actor",
+                               {"name": name, "namespace": namespace},
+                               timeout=10)
+            if info.get("error") or info.get("state") != "ALIVE":
+                return None
+            h = ActorHandle(ActorID.from_hex(info["actor_id"]),
+                            info.get("class_name", ""))
+            if info.get("worker_address"):
+                h._worker_address = info["worker_address"]
+            if info.get("node_id"):
+                self._replica_nodes[h._id_hex] = info["node_id"]
+            return h
+        except Exception:
+            logger.warning("serve journal: re-adopt of %r failed", name,
+                           exc_info=True)
+            return None
+
+    def _bump_seq_past(self, replica_name: str):
+        """Never reuse a live replica's name: advance the sequence past
+        any adopted ``...#<seq>`` suffix (covers a journal meta write
+        lost right before the crash)."""
+        _, _, seq = replica_name.rpartition("#")
+        try:
+            self._replica_seq = max(self._replica_seq, int(seq))
+        except ValueError:
+            pass
+
+    def _adopt_orphans(self, namespace: str):
+        """A crash between replica creation and the journal write leaks
+        a live detached replica the journal doesn't know. Sweep the
+        actor directory for ``SERVE_REPLICA::*`` names we don't track:
+        adopt the ones whose deployment still exists, kill the rest."""
+        import ray_tpu
+        try:
+            from ray_tpu._private.worker import global_worker
+            w = global_worker()
+            named = w.call_sync(w.gcs, "list_named_actors",
+                                {"namespace": namespace}, timeout=10)
+        except Exception:
+            return
+        tracked = set()
+        for info in self._deployments.values():
+            tracked.update(info.replica_names.values())
+        for entry in named or []:
+            name = entry.get("name", "")
+            if not name.startswith(REPLICA_NAME_PREFIX) or name in tracked:
+                continue
+            dep_name = name[len(REPLICA_NAME_PREFIX):].rpartition("#")[0]
+            h = self._readopt_replica({"name": name}, namespace)
+            if h is None:
+                continue
+            self._bump_seq_past(name)
+            info = self._deployments.get(dep_name)
+            version = None
+            try:
+                meta = ray_tpu.get(h.get_replica_metadata.remote(),
+                                   timeout=10.0)
+                version = meta.get("version")
+            except Exception:
+                pass
+            if info is None:
+                logger.warning("serve: killing orphan replica %r "
+                               "(deployment gone)", name)
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+                continue
+            info.replicas[h] = version or info.version
+            info.replica_names[h._id_hex] = name
+            self._adopted += 1
 
     # ---- API called by serve.run / handles ----
 
@@ -84,14 +374,15 @@ class ServeController:
                             "deployment names must be unique across apps"}
                 info = _DeploymentInfo(d)
                 if existing is not None:
-                    info.replicas = existing.replicas
-                    info.ready = existing.ready
+                    info.carry_over(existing)
                 self._deployments[d["name"]] = info
+                self._journal_deployment(d["name"])
             same_app = {n for n, i in self._deployments.items()
                         if i.config.get("app_name", "default") == app_name}
             for stale in same_app - new_names:
                 self._deployments[stale].target_replicas = 0
                 self._deployments[stale].config["_deleted"] = True
+                self._journal_deployment(stale)
         self._reconcile_once()
         return "ok"
 
@@ -101,6 +392,7 @@ class ServeController:
                 if n in self._deployments:
                     self._deployments[n].target_replicas = 0
                     self._deployments[n].config["_deleted"] = True
+                    self._journal_deployment(n)
         return "ok"
 
     def delete_application(self, app_name: str):
@@ -111,6 +403,7 @@ class ServeController:
                 if info.config.get("app_name", "default") == app_name:
                     info.target_replicas = 0
                     info.config["_deleted"] = True
+                    self._journal_deployment(n)
         self._reconcile_once()
         return "ok"
 
@@ -136,30 +429,72 @@ class ServeController:
             for name, info in self._deployments.items():
                 if info.config.get("_deleted"):
                     continue
-                n_live = sum(1 for h in info.replicas if h in info.ready)
+                live = sum(1 for h, v in info.replicas.items()
+                           if v == info.version and h in info.ready
+                           and h not in info.draining)
+                stale = sum(1 for h, v in info.replicas.items()
+                            if h not in info.draining
+                            and (v != info.version or h in info.condemned))
                 out[name] = {
                     "name": name,
                     "app": info.config.get("app_name", "default"),
+                    # HEALTHY = the TARGET version is fully serving: a
+                    # mid-rollout deployment (old version still in the
+                    # table) reports UPDATING even though traffic flows
                     "status": ("HEALTHY"
-                               if n_live >= info.target_replicas
+                               if live >= info.target_replicas
+                               and stale == 0
                                else "UPDATING"),
                     "target_replicas": info.target_replicas,
-                    "live_replicas": n_live,
+                    "live_replicas": live,
+                    "stale_replicas": stale,
+                    "draining_replicas": len(info.draining),
                     "version": info.version,
                 }
-                if self._last_error:
-                    out[name]["last_controller_error"] = self._last_error
+                # scoped: only the deployment whose reconcile/health
+                # pass errored carries the message
+                if name in self._last_errors:
+                    out[name]["last_controller_error"] = \
+                        self._last_errors[name]
             return out
+
+    def get_controller_info(self) -> Dict[str, Any]:
+        """Introspection for tests/bench/ops: restart identity, journal
+        recovery outcome, and loop-level (non-deployment) errors."""
+        return {
+            "pid": os.getpid(),
+            "recovered": self._recovered,
+            "adopted_replicas": self._adopted,
+            "replica_seq": self._replica_seq,
+            "last_error": self._last_error,
+        }
 
     def get_http_port(self):
         return self._http_port
 
     def shutdown(self):
+        """Explicit teardown (serve.shutdown): fast-stop every replica —
+        graceful drain is for keeping traffic alive, and there is no
+        traffic to keep alive after an intentional full teardown."""
+        from ray_tpu.serve._private import journal
         self._shutdown.set()
         with self._lock:
+            handles = []
             for info in self._deployments.values():
                 info.target_replicas = 0
-        self._reconcile_once()
+                handles.extend(info.replicas)
+                info.replicas = {}
+                info.draining = {}
+                info.ready = set()
+            self._deployments = {}
+        for h in handles:
+            self._stop_replica(h)
+        try:
+            journal.clear()
+        except Exception:
+            logger.warning("serve journal: clear on shutdown failed",
+                           exc_info=True)
+        self._publish_route_table(force=True)
         return "ok"
 
     def ping(self):
@@ -168,17 +503,37 @@ class ServeController:
     # ---- reconciliation ----
 
     def _control_loop(self):
-        import traceback
+        from ray_tpu._private import chaos
         while not self._shutdown.is_set():
             try:
+                if chaos._ENGINE is not None:
+                    # op "kill" = SIGKILL this worker; the GCS actor
+                    # state machine restarts the controller, which
+                    # recovers from the journal
+                    chaos.hit("serve.controller.tick")
+                self._refresh_node_view()
                 self._reconcile_once()
                 self._metrics_tick()
                 self._health_check()
                 self._last_error = None
             except Exception:
-                # keep reconciling, but surface the failure in statuses
+                # keep reconciling, but surface the failure
                 self._last_error = traceback.format_exc(limit=8)
             self._shutdown.wait(1.0)
+
+    def _refresh_node_view(self):
+        """Draining-node set from the GCS node table: replicas living
+        there must be replaced before the grace window closes."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            w = global_worker()
+            nodes = w.call_sync(w.gcs, "get_nodes", {}, timeout=5)
+        except Exception:
+            return  # keep the previous view
+        self._draining_nodes = {
+            n["node_id"]: float(n.get("drain_deadline_unix") or 0.0)
+            for n in nodes
+            if n.get("alive") and n.get("draining")}
 
     def _start_replica(self, name: str, info: _DeploymentInfo):
         import ray_tpu
@@ -190,8 +545,9 @@ class ServeController:
         if max_queued is None:
             from ray_tpu.serve._private.replica import _default_max_queued
             max_queued = _default_max_queued(mcq)
+        replica_name = f"{REPLICA_NAME_PREFIX}{name}#{self._replica_seq}"
         opts = dict(
-            name=f"SERVE_REPLICA::{name}#{self._replica_seq}",
+            name=replica_name,
             # The actor thread pool must hold executing requests (mcq) +
             # the bounded waiting room (max_queued: threads parked on the
             # replica's execution semaphore) + headroom so a saturated
@@ -212,6 +568,8 @@ class ServeController:
             max_concurrent_queries=mcq,
             max_queued_requests=max_queued)
         info.replicas[h] = info.version
+        info.replica_names[h._id_hex] = replica_name
+        return h
 
     def _stop_replica(self, handle):
         import ray_tpu
@@ -227,44 +585,197 @@ class ServeController:
         except Exception:
             pass
 
-    def _reconcile_once(self):
-        import ray_tpu
+    def _forget_replica(self, info: _DeploymentInfo, h):
+        """Drop every trace of a replica from one deployment's state.
+        Caller holds the lock."""
+        info.replicas.pop(h, None)
+        info.ready.discard(h)
+        info.draining.pop(h, None)
+        info.condemned.discard(h)
+        info.health_fails.pop(h._id_hex, None)
+        info.replica_names.pop(h._id_hex, None)
+        self._replica_nodes.pop(h._id_hex, None)
+
+    def _least_loaded(self, name: str, handles) -> List[Any]:
+        """Sort by last-reported queue depth ascending (the downscale /
+        drain victim order) — never evict the busiest replica when a
+        quieter one frees the same capacity."""
+        loads = self._last_load_table.get(name, {})
+
+        def key(h):
+            rep = loads.get(h._id_hex) or {}
+            return (float(rep.get("queue_len", 0.0)), h._id_hex)
+
+        return sorted(handles, key=key)
+
+    def _lookup_replica_node(self, h) -> Optional[str]:
+        nid = self._replica_nodes.get(h._id_hex)
+        if nid is not None:
+            return nid
+        try:
+            from ray_tpu._private.worker import global_worker
+            w = global_worker()
+            info = w.call_sync(w.gcs, "get_actor",
+                               {"actor_id": h._id_hex}, timeout=5)
+            nid = info.get("node_id")
+            if nid:
+                self._replica_nodes[h._id_hex] = nid
+            return nid
+        except Exception:
+            return None
+
+    def _begin_drain(self, name: str, info: _DeploymentInfo, h,
+                     reason: str):
+        """Take a replica out of service WITHOUT dropping its work:
+        remove it from the published table (caller republishes), tell
+        it to shed new arrivals retriably, and schedule the kill for
+        when it is idle (bounded by graceful_shutdown_timeout_s)."""
+        now = time.time()
+        deadline = now + info.graceful_timeout_s()
+        if h in info.condemned:
+            # finish before the node's own grace window slams shut
+            node_dl = self._draining_nodes.get(
+                self._replica_nodes.get(h._id_hex) or "", 0.0)
+            if node_dl:
+                deadline = min(deadline, max(now, node_dl - 1.0))
+        # "notified" stays False for one propagation window: the route
+        # table WITHOUT this replica must reach routers before the
+        # replica starts shedding stragglers (else requests assigned in
+        # the window surface errors instead of landing elsewhere)
+        info.draining[h] = {"deadline": deadline, "begun": now,
+                            "notified": False, "reason": reason}
+        info.ready.discard(h)
+        logger.info("serve: draining replica %s of %r (%s)",
+                    info.replica_names.get(h._id_hex,
+                                           h._id_hex[:8]), name, reason)
+
+    def _reconcile_deployment(self, name: str, info: _DeploymentInfo
+                              ) -> bool:
+        """One deployment's convergence step. Caller holds the lock.
+        Returns True when membership (and thus the route table or the
+        journal) changed."""
         changed = False
+        # 0) condemn replicas on draining nodes — they need start-
+        # before-stop replacement exactly like a stale version
+        if self._draining_nodes:
+            for h in list(info.replicas):
+                if h in info.draining or h in info.condemned:
+                    continue
+                nid = self._lookup_replica_node(h)
+                if nid and nid in self._draining_nodes:
+                    info.condemned.add(h)
+                    changed = True
+        cur = [h for h, v in info.replicas.items()
+               if v == info.version and h not in info.draining
+               and h not in info.condemned]
+        stale = [h for h in info.replicas
+                 if h not in info.draining and h not in cur]
+        target = max(0, info.target_replicas)
+        surge = _max_surge()
+        # 1) start-before-stop: bring the current version up first.
+        # Initial deploys (no stale) scale straight to target; rolling
+        # updates are bounded to `surge` extra replicas per wave.
+        while len(cur) < target and len(cur) + len(stale) < target + surge:
+            cur.append(self._start_replica(name, info))
+            changed = True
+        # 2) the health gate: drain stale replicas only one-for-one
+        # against new replicas that PASSED health checks — a broken new
+        # version never takes the old one down
+        ready_cur = sum(1 for h in cur if h in info.ready)
+        n_drain = min(len(stale), max(0, ready_cur + len(stale) - target))
+        if n_drain:
+            for h in self._least_loaded(name, stale)[:n_drain]:
+                self._begin_drain(name, info, h, "rolling update")
+                changed = True
+        # 3) downscale: drain the least-loaded current-version replicas
+        if len(cur) > target:
+            for h in self._least_loaded(name, cur)[:len(cur) - target]:
+                self._begin_drain(name, info, h, "downscale")
+                changed = True
+        return changed
+
+    def _reconcile_once(self):
+        changed = False
+        drain_polls: List[Tuple[str, _DeploymentInfo, Any,
+                                Dict[str, Any]]] = []
         with self._lock:
             for name, info in list(self._deployments.items()):
-                # rolling update: replace replicas on an old version
-                stale = [h for h, v in info.replicas.items()
-                         if v != info.version]
-                for h in stale:
-                    self._stop_replica(h)
-                    del info.replicas[h]
-                    info.ready.discard(h)
-                    changed = True
-                delta = info.target_replicas - len(info.replicas)
-                for _ in range(max(0, delta)):
-                    self._start_replica(name, info)
-                    changed = True
-                for _ in range(max(0, -delta)):
-                    h = next(iter(info.replicas))
-                    self._stop_replica(h)
-                    del info.replicas[h]
-                    info.ready.discard(h)
-                    changed = True
-                if info.config.get("_deleted") and not info.replicas:
-                    del self._deployments[name]
-                    changed = True
+                try:
+                    if self._reconcile_deployment(name, info):
+                        changed = True
+                        self._journal_deployment(name)
+                    self._last_errors.pop(name, None)
+                except Exception:
+                    self._last_errors[name] = traceback.format_exc(limit=8)
+                for h, st in info.draining.items():
+                    drain_polls.append((name, info, h, st))
+            self._journal_meta()
+        # poll draining replicas outside the lock (an RPC per draining
+        # replica; a wedged one must not block deploys/statuses)
+        if self._poll_draining(drain_polls):
+            changed = True
         if changed:
             self._publish_route_table()
 
-    def _publish_route_table(self):
+    def _poll_draining(self, polls) -> bool:
+        """Kill each draining replica once its in-flight count reaches
+        zero or its grace deadline passes."""
+        import ray_tpu
+        if not polls:
+            return False
+        changed = False
+        now = time.time()
+        for name, info, h, st in polls:
+            # give the replica-less route table one propagation window
+            # (long-poll push is ~ms; 1 s covers a reconnecting client)
+            # before shedding/killing
+            if now - st.get("begun", now) < min(
+                    1.0, max(0.0, st["deadline"] - st.get("begun", now))):
+                continue
+            if not st.get("notified"):
+                st["notified"] = True
+                try:
+                    h.prepare_drain.remote()
+                except Exception:
+                    pass
+            idle = False
+            if now < st["deadline"]:
+                try:
+                    load = ray_tpu.get(h.get_load.remote(), timeout=2.0)
+                    idle = load.get("queue_len", 0) <= 0
+                except Exception:
+                    idle = True  # dead/unreachable: nothing left to drain
+            if not idle and now < st["deadline"]:
+                continue
+            self._stop_replica(h)
+            with self._lock:
+                self._forget_replica(info, h)
+                live = self._deployments.get(name)
+                if live is not None:
+                    if live is not info:
+                        self._forget_replica(live, h)
+                    if live.config.get("_deleted") and not live.replicas:
+                        del self._deployments[name]
+                    self._journal_deployment(name)
+            changed = True
+        return changed
+
+    def _publish_route_table(self, force: bool = False):
         with self._lock:
             table = {}
             for name, info in self._deployments.items():
                 if info.config.get("_deleted"):
                     continue
                 table[name] = {
+                    # only health-confirmed replicas carry traffic: a
+                    # just-started (possibly broken) replica enters the
+                    # table when its first probe passes, and a draining
+                    # replica is already out — removal from the table
+                    # is step 1 of the drain
                     "replicas": [h._id_hex
-                                 for h in info.replicas],
+                                 for h in info.replicas
+                                 if h in info.ready
+                                 and h not in info.draining],
                     "max_concurrent_queries":
                         info.config.get("max_concurrent_queries", 100),
                     "max_queued_requests":
@@ -276,28 +787,68 @@ class ServeController:
                     "pass_http_method":
                         bool(info.config.get("pass_http_method")),
                 }
+            if not force and table == self._last_published_table:
+                return
+            self._last_published_table = table
         self._long_poll.notify_changed("route_table", table)
 
     def _health_check(self):
+        """Probe EVERY replica concurrently (one wedged probe no longer
+        delays the others by its full timeout), and remove a replica
+        only after ``RTPU_SERVE_HEALTH_FAILURES`` consecutive failures —
+        except a definitively dead actor, which is removed at once."""
         import ray_tpu
+        from ray_tpu import exceptions as rexc
         with self._lock:
-            items = [(name, info, list(info.replicas))
-                     for name, info in self._deployments.items()]
-        dead = []
-        for name, info, handles in items:
-            for h in handles:
-                try:
-                    ray_tpu.get(h.check_health.remote(), timeout=10.0)
+            probes = [(name, info, h)
+                      for name, info in self._deployments.items()
+                      for h in list(info.replicas)
+                      if h not in info.draining]
+        if not probes:
+            return
+        refs = [h.check_health.remote() for _, _, h in probes]
+        done, _pending = ray_tpu.wait(
+            refs, num_returns=len(refs), timeout=_health_timeout_s())
+        done_ids = {id(r) for r in done}
+        threshold = _health_failure_threshold()
+        removals = []
+        newly_ready = False
+        with self._lock:
+            for (name, info, h), ref in zip(probes, refs):
+                ok = dead = False
+                if id(ref) in done_ids:
+                    try:
+                        ray_tpu.get(ref, timeout=5.0)
+                        ok = True
+                    except (rexc.ActorDiedError,
+                            rexc.ActorUnavailableError):
+                        dead = True
+                    except Exception:
+                        pass  # user check_health raised / probe error
+                if h not in info.replicas:
+                    continue  # removed by a concurrent path meanwhile
+                if ok:
+                    info.health_fails.pop(h._id_hex, None)
                     if h not in info.ready:
-                        with self._lock:
-                            info.ready.add(h)
-                except Exception:
-                    dead.append((info, h))
-        if dead:
-            with self._lock:
-                for info, h in dead:
-                    info.replicas.pop(h, None)
-                    info.ready.discard(h)
+                        info.ready.add(h)
+                        newly_ready = True
+                    continue
+                fails = info.health_fails.get(h._id_hex, 0) + 1
+                info.health_fails[h._id_hex] = fails
+                if dead or fails >= threshold:
+                    removals.append((name, info, h))
+            for name, info, h in removals:
+                logger.warning(
+                    "serve: removing unhealthy replica %s of %r",
+                    info.replica_names.get(h._id_hex, h._id_hex[:8]),
+                    name)
+                self._forget_replica(info, h)
+                self._journal_deployment(name)
+        if newly_ready:
+            # a replica passing its FIRST probe enters the route table
+            # (and may unlock the next rolling-update wave)
+            self._publish_route_table()
+        if removals:
             # routers must stop picking the dead replicas NOW — the next
             # reconcile replaces them, but the table with them removed
             # has to go out immediately
@@ -312,7 +863,8 @@ class ServeController:
         import ray_tpu
         now = time.time()
         with self._lock:
-            items = [(name, info, list(info.replicas))
+            items = [(name, info,
+                      [h for h in info.replicas if h not in info.draining])
                      for name, info in self._deployments.items()
                      if not info.config.get("_deleted")]
         load_table: Dict[str, Dict[str, Any]] = {}
@@ -339,6 +891,7 @@ class ServeController:
                 if decision != info.target_replicas:
                     with self._lock:
                         info.target_replicas = decision
+                        self._journal_deployment(name)
         if load_table or self._last_load_table:
             self._last_load_table = load_table
             self._long_poll.notify_changed("replica_load", load_table)

@@ -5,6 +5,13 @@ routes through the shared Router (backpressure-aware) and returns the
 underlying ObjectRef; the in-flight slot is released when the ref
 completes, so handle callers and the HTTP proxy share one flow-control
 mechanism.
+
+Controller HA: a handle pins the controller by ACTOR ID, and the GCS
+restarts the controller under the same id (``max_restarts=-1``) — so a
+handle serialized before a controller crash deserializes to a working
+handle afterwards. ``.remote()`` itself never talks to the controller
+once the router has a cached replica set for the deployment, so handle
+traffic keeps flowing straight through a controller outage.
 """
 
 from __future__ import annotations

@@ -9,6 +9,12 @@ retry on other replicas), so HTTP and handle traffic obey the same
 when every replica is at capacity (router assign times out after
 ``RTPU_SERVE_PROXY_ASSIGN_TIMEOUT_S``) or the overload retries exhaust,
 the proxy answers a retriable 503 instead of queueing unboundedly.
+
+Controller HA: the proxy serves every request from its CACHED route
+table + replica sets — a controller outage never stops the data plane.
+Synchronous refreshes degrade to the cache on failure, and the route
+long-poll reconnects with backoff once the restarted controller is
+back (see serve/_private/long_poll.py).
 """
 
 from __future__ import annotations
@@ -52,10 +58,17 @@ class HTTPProxyActor:
         self._routes = routes
 
     def _refresh_routes(self):
+        """Synchronous route pull, resilient to a controller outage:
+        the proxy MUST keep answering from its cached routes while the
+        controller restarts (the long-poll re-delivers on recovery)."""
         import ray_tpu
-        _, table = ray_tpu.get(
-            self._controller.get_route_table.remote())
-        self._on_route_update(table)
+        try:
+            _, table = ray_tpu.get(
+                self._controller.get_route_table.remote(), timeout=10.0)
+        except Exception:
+            return  # keep serving the cached table
+        if table is not None:
+            self._on_route_update(table)
 
     def _match(self, path: str):
         """Longest-prefix route match → (deployment name, matched prefix)

@@ -27,6 +27,8 @@ class DeploymentSchema(BaseModel):
     autoscaling_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
     route_prefix: Optional[str] = None
+    # drain window for a replica leaving service (docs/SERVE_HA.md)
+    graceful_shutdown_timeout_s: Optional[float] = None
 
 
 class ServeApplicationSchema(BaseModel):
