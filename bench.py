@@ -706,6 +706,110 @@ def _chaos_bench_main():
     print(json.dumps({"metric": "chaos", **out}), flush=True)
 
 
+# ------------------------------------------------------ state-engine bench
+
+
+def _state_bench_main():
+    """State-engine microbench (_BENCH_STATE=1): with 10k+ drained
+    tasks in the GCS task table, measure (a) list_tasks first-page p50
+    latency, (b) a full paginated walk, (c) the naive full-dump (one
+    legacy RPC carrying the whole table — what every list call did
+    before pagination), and (d) the head-node (GCS) RSS delta from
+    holding the bounded table. One JSON line; recorded in PERF.md."""
+    import statistics
+    import subprocess as sp
+
+    import ray_tpu
+    from ray_tpu._private import worker as wmod
+    from ray_tpu.experimental.state import api as state_api
+
+    n = int(os.environ.get("STATE_BENCH_TASKS", 10_000))
+
+    def gcs_rss() -> int:
+        pid = int(sp.check_output(
+            ["pgrep", "-f", "ray_tpu._private.gcs_main"]).split()[0])
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) * 1024
+        return 0
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    out = {}
+    try:
+        rss0 = gcs_rss()
+
+        @ray_tpu.remote
+        def sb_noop(i):
+            return i
+
+        t0 = time.perf_counter()
+        ray_tpu.get(sb_noop.remote_batch([(i,) for i in range(n)]),
+                    timeout=900)
+        out["drain_s"] = round(time.perf_counter() - t0, 2)
+        # wait for the event pipeline to settle into the table
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = state_api.summarize_tasks()
+            tracked = s["by_state"].get("FINISHED", 0) + s["dropped"]
+            if tracked >= n:
+                break
+            time.sleep(0.5)
+        out["tasks_tracked"] = s["total"]
+        out["tasks_dropped"] = s["dropped"]
+        out["gcs_rss_delta_mb"] = round((gcs_rss() - rss0) / 1e6, 1)
+
+        lat = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            page = state_api.list_tasks(page_size=1000)
+            lat.append(time.perf_counter() - t0)
+        assert len(page) == 1000
+        out["page1k_p50_ms"] = round(
+            1e3 * statistics.median(lat), 2)
+        t0 = time.perf_counter()
+        full = state_api.list_tasks()
+        out["paginated_walk_s"] = round(time.perf_counter() - t0, 3)
+        out["rows_walked"] = len(full)
+        # naive legacy path: the whole table in ONE rpc reply
+        w = wmod._global_worker
+        lat = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            rows = w.call_sync(w.gcs, "list_tasks", {}, timeout=120)
+            lat.append(time.perf_counter() - t0)
+        assert len(rows) == len(full)
+        out["naive_full_dump_p50_ms"] = round(
+            1e3 * statistics.median(lat), 2)
+    finally:
+        ray_tpu.shutdown()
+    # deterministic table-cost measurement (live GCS RSS deltas get
+    # absorbed by allocator arenas): n records through a fresh table
+    # in this process
+    from ray_tpu._private.gcs import TaskEventTable
+
+    def rss_self() -> int:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) * 1024
+        return 0
+
+    r0 = rss_self()
+    table = TaskEventTable(cap=max(n, 32768))
+    now = time.time()
+    for i in range(n):
+        tid = f"{i:032x}"
+        table.apply({"task_id": tid, "state": "PENDING_SCHEDULING",
+                     "ts": now, "name": "sb_noop", "job_id": "01"})
+        table.apply({"task_id": tid, "state": "RUNNING", "ts": now,
+                     "node_id": "n" * 32, "worker_pid": 1234})
+        table.apply({"task_id": tid, "state": "FINISHED", "ts": now})
+    out["table_cost_mb"] = round((rss_self() - r0) / 1e6, 2)
+    print(json.dumps({"metric": "state_engine", "n_tasks": n, **out}),
+          flush=True)
+
+
 # ------------------------------------------------------- serve data-plane bench
 
 class _BenchSeqCounter:
@@ -1123,6 +1227,12 @@ def main():
     elif os.environ.get("_BENCH_CHAOS"):
         try:
             _chaos_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_STATE"):
+        try:
+            _state_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

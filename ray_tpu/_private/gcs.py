@@ -30,14 +30,173 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol
+from ray_tpu._private import task_events as tev
 from ray_tpu._private.gcs_store import StoreClient, make_store
 from ray_tpu.common.config import SystemConfig
 
 logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------
+# List pagination + server-side filtering (shared by every list_*
+# handler; reference: the dashboard state_aggregator's ListApiOptions
+# — limit / server-side filters / a continuation token so no RPC ever
+# carries the full table of a large cluster in one response).
+
+def _match_row(row: Dict[str, Any], filters: Optional[Dict[str, Any]]
+               ) -> bool:
+    """Equality filter pushdown; a list/tuple value means membership."""
+    if not filters:
+        return True
+    for k, v in filters.items():
+        have = row.get(k)
+        if isinstance(v, (list, tuple)):
+            if have not in v:
+                return False
+        elif have != v:
+            return False
+    return True
+
+
+_LIST_LIMIT_DEFAULT = 1000
+_LIST_LIMIT_MAX = 10_000
+
+
+def paginate(rows, payload: Dict[str, Any], id_key: str):
+    """Apply filters, then (when the client asked for the paged shape)
+    sort by the stable ``id_key`` and cut a cursor page.
+
+    Legacy callers (no ``paged`` flag) get the old bare-list reply, so
+    every pre-pagination peer keeps working; paged callers get
+    ``{"items", "next_token", "total"}`` where ``next_token`` is the
+    last id of the page — pass it back to resume strictly after it
+    (ids are unique + the sort is stable, so pages never overlap and
+    their union is the full filtered set even as rows churn).
+    """
+    payload = payload or {}
+    filters = payload.get("filters")
+    rows = [r for r in rows if _match_row(r, filters)]
+    if not payload.get("paged"):
+        return rows
+    rows.sort(key=lambda r: str(r.get(id_key, "")))
+    total = len(rows)
+    token = payload.get("continuation_token")
+    if token:
+        rows = [r for r in rows if str(r.get(id_key, "")) > str(token)]
+    limit = int(payload.get("limit") or _LIST_LIMIT_DEFAULT)
+    limit = max(1, min(limit, _LIST_LIMIT_MAX))
+    page = rows[:limit]
+    next_token = str(page[-1].get(id_key, "")) \
+        if len(rows) > limit and page else None
+    return {"items": page, "next_token": next_token, "total": total}
+
+
+class TaskEventTable:
+    """Bounded, indexed task table fed by the task-event pipeline
+    (reference: gcs_task_manager.cc GcsTaskManager — same contract:
+    RAY_task_events_max_num_task_in_gcs cap, oldest-finished evicted
+    first, a visible drop counter instead of silent loss).
+
+    Never O(all-tasks-ever): memory is ``cap`` records; everything
+    beyond it increments ``dropped`` and disappears.
+    """
+
+    def __init__(self, cap: Optional[int] = None):
+        if cap is None:
+            cap = int(os.environ.get("RTPU_TASK_TABLE_MAX", 32768))
+        self.cap = max(1, int(cap))
+        self.records: Dict[str, Dict[str, Any]] = {}
+        from collections import deque
+        self._terminal_order: "deque[str]" = deque()
+        self.dropped = 0          # records evicted past the cap
+        self.events_dropped = 0   # process-ring overflow (reported in)
+        self.state_counts: Dict[str, int] = {}
+        self.total_seen = 0       # records ever created
+
+    def _count(self, state: Optional[str], delta: int):
+        if state:
+            self.state_counts[state] = \
+                self.state_counts.get(state, 0) + delta
+
+    def apply(self, ev: Dict[str, Any]):
+        tid = ev.get("task_id")
+        state = ev.get("state")
+        if not tid or state not in tev.STATE_RANK:
+            return
+        rec = self.records.get(tid)
+        if rec is None:
+            rec = {"task_id": tid, "state": state, "attempt": 0,
+                   "created_ts": ev.get("ts")}
+            self.records[tid] = rec
+            self.total_seen += 1
+            self._count(state, +1)
+            self._evict()
+        # fields merge regardless of ordering (a late PENDING event
+        # still fills in name/job_id it uniquely knows)
+        for k in ("name", "job_id", "node_id", "worker_pid",
+                  "trace_ctx"):
+            if ev.get(k) is not None:
+                rec[k] = ev[k]
+        attempt = int(ev.get("attempt") or 0)
+        old_rank = tev.STATE_RANK.get(rec["state"], -1)
+        new_rank = tev.STATE_RANK[state]
+        if attempt > rec["attempt"]:
+            # a retry restarts the lifecycle: state may regress
+            rec["attempt"] = attempt
+            advance = True
+        elif attempt < rec["attempt"]:
+            # stale attempt (flush ticks race across processes): its
+            # terminal state must not override the newer attempt
+            advance = False
+        else:
+            advance = new_rank >= old_rank
+        if advance and rec["state"] != state:
+            self._count(rec["state"], -1)
+            self._count(state, +1)
+            rec["state"] = state
+        if advance:
+            if state == tev.RUNNING:
+                rec["start_ts"] = ev.get("ts")
+            elif state in tev.TERMINAL_STATES:
+                rec["end_ts"] = ev.get("ts")
+                if rec.get("start_ts") and ev.get("ts"):
+                    rec["duration_s"] = round(
+                        ev["ts"] - rec["start_ts"], 6)
+                if ev.get("error") is not None:
+                    rec["error"] = str(ev["error"])[:500]
+                self._terminal_order.append(tid)
+
+    def _evict(self):
+        while len(self.records) > self.cap:
+            victim = None
+            # oldest-terminal first: live tasks are what an operator is
+            # debugging; history is what we can afford to forget
+            while self._terminal_order:
+                cand = self._terminal_order.popleft()
+                rec = self.records.get(cand)
+                if rec is not None and \
+                        rec["state"] in tev.TERMINAL_STATES:
+                    victim = cand
+                    break
+            if victim is None:
+                victim = next(iter(self.records))
+            rec = self.records.pop(victim, None)
+            if rec is not None:
+                self._count(rec["state"], -1)
+                self.dropped += 1
+
+    def summary(self) -> Dict[str, Any]:
+        return {"total": len(self.records),
+                "total_seen": self.total_seen,
+                "by_state": dict(self.state_counts),
+                "dropped": self.dropped,
+                "events_dropped": self.events_dropped,
+                "cap": self.cap}
 
 # Actor states (reference: design_docs/actor_states.rst)
 DEPS_UNREADY = "DEPENDENCIES_UNREADY"
@@ -99,6 +258,9 @@ class GcsServer:
         # browsable via the state API / dashboard /api/events)
         from collections import deque
         self.events: "deque" = deque(maxlen=1000)
+        # bounded task table fed by the task-event pipeline (reference:
+        # gcs_task_manager.cc); cap via RTPU_TASK_TABLE_MAX
+        self.task_table = TaskEventTable()
         # scheduler's pessimistic view of its own in-flight placements:
         # node_id -> [(expiry, demand)] (see _utilization)
         self._ephemeral_allocs: Dict[str, List[Tuple[float, Dict[str,
@@ -146,6 +308,12 @@ class GcsServer:
             "list_actors": self.list_actors,
             "add_event": self.add_event,
             "list_events": self.list_events,
+            "task_events": self.task_events,
+            "list_tasks": self.list_tasks,
+            "list_objects": self.list_objects,
+            "summarize": self.summarize,
+            "summarize_tasks": self.summarize_tasks,
+            "configure_state": self.configure_state,
             "schedule": self.schedule,
             "create_placement_group": self.create_placement_group,
             "remove_placement_group": self.remove_placement_group,
@@ -280,11 +448,138 @@ class GcsServer:
         return {}
 
     async def list_events(self, payload, conn):
-        limit = (payload or {}).get("limit", 200)
-        sev = (payload or {}).get("severity")
+        payload = payload or {}
+        limit = payload.get("limit", 200)
+        sev = payload.get("severity")
         out = [e for e in self.events
                if sev is None or e.get("severity") == sev]
+        out = [e for e in out if _match_row(e, payload.get("filters"))]
         return out[-limit:] if limit and limit > 0 else []
+
+    # ------------------------------------------------------ task state
+
+    async def task_events(self, payload, conn):
+        """Batched task lifecycle events from workers/raylets — folded
+        into the bounded task table (never stored raw)."""
+        payload = payload or {}
+        self.task_table.events_dropped += int(payload.get("dropped") or 0)
+        for ev in payload.get("events") or ():
+            try:
+                self.task_table.apply(ev)
+            except Exception:
+                logger.debug("bad task event dropped: %r", ev,
+                             exc_info=True)
+        return {}
+
+    async def list_tasks(self, payload, conn):
+        rows = [dict(r) for r in self.task_table.records.values()]
+        reply = paginate(rows, payload, "task_id")
+        if isinstance(reply, dict):
+            reply["dropped"] = self.task_table.dropped
+            reply["events_dropped"] = self.task_table.events_dropped
+        return reply
+
+    async def list_objects(self, payload, conn):
+        """Cluster object listing: aggregates the PER-RAYLET plasma
+        indexes (each raylet reports its own bounded page) instead of
+        the GCS centralizing every object record — the head holds only
+        the location directory, and one listing RPC never materializes
+        more than ~limit rows per node."""
+        payload = payload or {}
+        limit = int(payload.get("limit") or _LIST_LIMIT_DEFAULT)
+        limit = max(1, min(limit, _LIST_LIMIT_MAX))
+        req = {"limit": limit,
+               "continuation_token": payload.get("continuation_token")}
+        fan = await self._fanout_to_raylets(
+            "list_objects", req, node_id=payload.get("node_id"))
+        merged: Dict[str, Dict[str, Any]] = {}
+        truncated = False
+        for node_reply in fan["nodes"]:
+            if node_reply.get("error"):
+                continue
+            truncated = truncated or bool(node_reply.get("truncated"))
+            for row in node_reply.get("objects") or ():
+                oid = row["object_id"]
+                have = merged.get(oid)
+                if have is None:
+                    have = merged[oid] = dict(row)
+                    have["locations"] = []
+                else:
+                    # keep the richer copy's size/pinned/spilled bits
+                    for k in ("size_bytes", "pinned", "spilled"):
+                        if row.get(k):
+                            have[k] = row[k]
+                have["locations"].append(row.get("node_id"))
+        for oid, rec in merged.items():
+            rec["owner"] = self.object_owners.get(oid)
+        rows = list(merged.values())
+        reply = paginate(rows, payload, "object_id")
+        if isinstance(reply, dict) and truncated and \
+                not reply.get("next_token") and reply["items"]:
+            # a raylet clipped its page at the limit: there IS more
+            # even though the merged cut didn't overflow
+            reply["next_token"] = reply["items"][-1]["object_id"]
+        return reply
+
+    async def summarize(self, payload, conn):
+        """Cluster summary in ONE rpc — counts computed where the
+        tables live instead of shipping full node/actor tables to the
+        client just to len() them."""
+        actors_by_state: Dict[str, int] = {}
+        for info in self.actors.values():
+            s = info.get("state") or "?"
+            actors_by_state[s] = actors_by_state.get(s, 0) + 1
+        return {
+            "nodes_total": len(self.nodes),
+            "nodes_alive": sum(1 for n in self.nodes.values() if n.alive),
+            "nodes_draining": sum(1 for n in self.nodes.values()
+                                  if n.alive and n.draining),
+            "actors_total": len(self.actors),
+            "actors_alive": actors_by_state.get(ALIVE, 0),
+            "actors_by_state": actors_by_state,
+            "jobs_total": len(self.jobs),
+            "placement_groups_total": len(self.placement_groups),
+            "objects_tracked": len(self.object_locations),
+            "cluster_resources": await self.cluster_resources({}, conn),
+            "available_resources": await self.available_resources({},
+                                                                  conn),
+            "tasks": self.task_table.summary(),
+        }
+
+    async def summarize_tasks(self, payload, conn):
+        """`ray-tpu summary tasks`: per-function aggregation over the
+        bounded table (reference: `ray summary tasks`)."""
+        by_func: Dict[str, Dict[str, Any]] = {}
+        for rec in self.task_table.records.values():
+            name = rec.get("name") or "(unknown)"
+            agg = by_func.get(name)
+            if agg is None:
+                agg = by_func[name] = {"name": name, "count": 0,
+                                       "by_state": {},
+                                       "duration_sum_s": 0.0,
+                                       "finished": 0}
+            agg["count"] += 1
+            st = rec["state"]
+            agg["by_state"][st] = agg["by_state"].get(st, 0) + 1
+            if rec.get("duration_s") is not None:
+                agg["duration_sum_s"] += rec["duration_s"]
+                agg["finished"] += 1
+        for agg in by_func.values():
+            if agg["finished"]:
+                agg["mean_duration_s"] = round(
+                    agg["duration_sum_s"] / agg["finished"], 6)
+        return {"summary": sorted(by_func.values(),
+                                  key=lambda a: -a["count"]),
+                **self.task_table.summary()}
+
+    async def configure_state(self, payload, conn):
+        """Operator/test knob: resize the task table cap live (shrink
+        evicts immediately, drop counter visible)."""
+        cap = (payload or {}).get("task_table_max")
+        if cap is not None:
+            self.task_table.cap = max(1, int(cap))
+            self.task_table._evict()
+        return {"task_table_max": self.task_table.cap}
 
     async def _health_loop(self):
         period = self.config.health_check_period_s
@@ -403,7 +698,7 @@ class GcsServer:
         return self._view_delta(int(payload.get("known_view", 0)))
 
     async def get_nodes(self, payload, conn):
-        return [{
+        rows = [{
             "node_id": n.node_id,
             "alive": n.alive,
             "draining": n.draining,
@@ -416,6 +711,7 @@ class GcsServer:
             "tpu": n.tpu,
             "is_head": n.is_head,
         } for n in self.nodes.values()]
+        return paginate(rows, payload, "node_id")
 
     async def _fanout_to_raylets(self, method: str, payload: Dict[str, Any],
                                  node_id: Optional[str] = None,
@@ -600,7 +896,7 @@ class GcsServer:
         return {}
 
     async def get_jobs(self, payload, conn):
-        return list(self.jobs.values())
+        return paginate(list(self.jobs.values()), payload, "job_id")
 
     # ----------------------------------------------------------------- pubsub
 
@@ -843,8 +1139,9 @@ class GcsServer:
         return out
 
     async def list_actors(self, payload, conn):
-        return [{k: v for k, v in info.items() if k != "create_spec"}
+        rows = [{k: v for k, v in info.items() if k != "create_spec"}
                 for info in self.actors.values()]
+        return paginate(rows, payload, "actor_id")
 
     async def wait_actor_alive(self, payload, conn):
         aid = payload["actor_id"]
@@ -1208,7 +1505,8 @@ class GcsServer:
         return pg
 
     async def list_placement_groups(self, payload, conn):
-        return list(self.placement_groups.values())
+        return paginate(list(self.placement_groups.values()), payload,
+                        "pg_id")
 
     # -------------------------------------------------------- object registry
 

@@ -46,6 +46,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import chaos, protocol
+from ray_tpu._private import task_events as tev
 from ray_tpu._private.object_store import PlasmaxStore
 from ray_tpu._private.sched import PendingTask, bundle_key_of, make_ledger
 from ray_tpu.exceptions import ObjectStoreFullError
@@ -359,6 +360,7 @@ class Raylet:
             "pin_object": self.handle_pin_object,
             "request_spill": self.handle_request_spill,
             "contains_object": self.handle_contains_object,
+            "list_objects": self.handle_list_objects,
             "get_info": self.handle_get_info,
             "node_stats": self.handle_node_stats,
             "dump_worker_stacks": self.handle_dump_worker_stacks,
@@ -388,6 +390,10 @@ class Raylet:
         reply = await self.gcs.call("register_node", self._register_payload())
         self.config = SystemConfig.from_json(reply["config"])
         loop = asyncio.get_running_loop()
+        # task-event shipping runs on this loop (the raylet has no
+        # global worker for the default thread flusher to use)
+        tev.set_external_flusher()
+        protocol.spawn(self._task_events_loop())
         protocol.spawn(self._dispatch_loop())
         protocol.spawn(self._report_loop())
         protocol.spawn(self._loop_tick_task())
@@ -649,6 +655,14 @@ class Raylet:
                 _, ptask = entry
                 self._release_resources(ptask, handle.tpu_chips)
                 handle.tpu_chips = ()
+                # the dead worker can't report its own failure — this
+                # raylet is the only process that saw it die
+                tev.emit(ptask.spec.get("task_id"), tev.FAILED,
+                         name=ptask.spec.get("fn_name"),
+                         job_id=ptask.spec.get("job_id"),
+                         node_id=self.node_id,
+                         attempt=ptask.spec.get("attempt"),
+                         error=f"WORKER_DIED: {reason}")
                 msg = {"error": "WORKER_DIED",
                        "message": f"worker {worker_id} died: {reason}"}
                 if ptask.reply_fut is not None and not ptask.reply_fut.done():
@@ -758,9 +772,18 @@ class Raylet:
                                               force=self._infeasible(ptask))
             if spill is not None:
                 return spill
+        self._note_queued(payload)
         self.led.append(ptask)
         self._dispatch_event.set()
         return await fut
+
+    def _note_queued(self, spec):
+        """Task accepted into this node's dispatch queue: the
+        PENDING_NODE_ASSIGNMENT lifecycle transition (O(1) ring
+        append; batched to the GCS off this path)."""
+        tev.emit(spec.get("task_id"), tev.PENDING_NODE_ASSIGNMENT,
+                 name=spec.get("fn_name"), job_id=spec.get("job_id"),
+                 node_id=self.node_id, attempt=spec.get("attempt"))
 
     async def handle_submit_task_batch(self, payload, conn):
         """Batched submission (the >=10k tasks/s path; reference gets its
@@ -810,10 +833,12 @@ class Raylet:
                                 "message": "node is draining "
                                            "(preemption notice)"})
                         return
+                    self._note_queued(pt.spec)
                     self.led.append(pt)
                     self._dispatch_event.set()
                 protocol.spawn(_spill())
             else:
+                self._note_queued(spec)
                 self.led.append(ptask)
             accepted += 1
         self._dispatch_event.set()
@@ -1938,6 +1963,64 @@ class Raylet:
         """
         n = await self._spill_until(int(payload.get("bytes_needed", 0)))
         return {"spilled": n}
+
+    async def handle_list_objects(self, payload, conn):
+        """This node's slice of the cluster object listing: the
+        per-raylet plasma index (pinned primaries + spilled primaries)
+        as a bounded, id-sorted page. The GCS aggregates these instead
+        of holding every object record itself (reference: the object
+        directory is locations-only; per-object detail stays where the
+        object lives)."""
+        payload = payload or {}
+        limit = max(1, min(int(payload.get("limit") or 1000), 10_000))
+        token = payload.get("continuation_token") or ""
+        rows: Dict[str, Dict[str, Any]] = {}
+        for hex_id, meta in self.pinned.items():
+            if hex_id <= token:
+                continue
+            rows[hex_id] = {"object_id": hex_id, "node_id": self.node_id,
+                            "pinned": True, "spilled": False,
+                            "owner": (meta or {}).get("owner")}
+        for hex_id, (_uri, size) in self.spilled.items():
+            if hex_id <= token:
+                continue
+            r = rows.setdefault(
+                hex_id, {"object_id": hex_id, "node_id": self.node_id,
+                         "pinned": False})
+            r["spilled"] = True
+            r["size_bytes"] = int(size)
+        ordered = sorted(rows.values(), key=lambda r: r["object_id"])
+        truncated = len(ordered) > limit
+        page = ordered[:limit]
+        # sizes for in-store objects: one bounded pass over the page
+        for r in page:
+            if r.get("size_bytes") is None:
+                oid = ObjectID.from_hex(r["object_id"])
+                buf = self.store.get_buffer(oid)
+                if buf is not None:
+                    r["size_bytes"] = len(buf)
+                    buf.release()
+                    self.store.release(oid)
+        return {"node_id": self.node_id, "objects": page,
+                "truncated": truncated}
+
+    async def _task_events_loop(self):
+        """Pump the process-local task-event ring to the GCS in batches
+        (the raylet-side leg of the task-event pipeline; workers use
+        the thread flusher in task_events.py)."""
+        while not self._shutdown:
+            await asyncio.sleep(tev._flush_interval())
+            while True:
+                batch, dropped = tev.drain()
+                if not batch and not dropped:
+                    break
+                try:
+                    await self.gcs.call(
+                        "task_events",
+                        {"events": batch, "dropped": dropped}, timeout=5)
+                except Exception:
+                    tev.requeue(batch, dropped)
+                    break
 
     async def handle_contains_object(self, payload, conn):
         hex_id = payload["object_id"]

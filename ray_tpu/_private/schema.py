@@ -39,7 +39,11 @@ from typing import Any, Dict, List, Optional, Tuple
 #      revoke-drain ack, per-chunk crc on pull_object replies.
 # 1.3: kv_get_prefix (bulk journal recovery reads — serve control-plane
 #      HA), drain_deadline_unix in get_nodes replies.
-PROTOCOL_VERSION = (1, 3)
+# 1.4: state engine — task_events batches, list_tasks/list_objects/
+#      summarize/summarize_tasks GCS methods, raylet-side list_objects,
+#      cursor pagination fields (paged/limit/continuation_token/filters)
+#      on every list_* method (legacy non-paged replies retained).
+PROTOCOL_VERSION = (1, 4)
 
 _str = str
 _num = numbers.Number
@@ -212,6 +216,20 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "profile_workers": {"worker_id": (_str, False),
                         "duration_s": (_num, False),
                         "interval_s": (_num, False)},
+    # ---- state engine (gcs_task_manager / state aggregator role).
+    # The pagination trio (paged/limit/continuation_token/filters) also
+    # rides the legacy list_* methods as unknown-but-allowed fields.
+    "task_events": {"events": (_list, True), "dropped": (_int, False)},
+    "list_tasks": {"paged": (_bool, False), "limit": (_int, False),
+                   "continuation_token": (_any, False),
+                   "filters": (_dict, False)},
+    "list_objects": {"paged": (_bool, False), "limit": (_int, False),
+                     "continuation_token": (_any, False),
+                     "filters": (_dict, False),
+                     "node_id": (_any, False)},
+    "summarize": {},
+    "summarize_tasks": {},
+    "configure_state": {"task_table_max": (_int, False)},
 }
 
 

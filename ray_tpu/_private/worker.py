@@ -36,6 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import chaos, protocol, serialization
+from ray_tpu._private import task_events as tev
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.object_store import MemoryStore, PlasmaxStore
 from ray_tpu.common.config import SystemConfig, global_config, set_global_config
@@ -352,7 +353,7 @@ class _CallbackEvent(threading.Event):
 
 class PendingTaskState:
     __slots__ = ("spec", "retries_left", "return_ids", "done",
-                 "result_event", "worker_address")
+                 "result_event", "worker_address", "attempt")
 
     def __init__(self, spec, retries_left, return_ids):
         self.spec = spec
@@ -361,6 +362,7 @@ class PendingTaskState:
         self.done = False
         self.result_event = _CallbackEvent()
         self.worker_address = None
+        self.attempt = 0  # bumped per retry; rides spec["attempt"]
 
 
 class _LeaseState:
@@ -546,6 +548,11 @@ class Worker:
         # reach their owners or they leak cluster-wide
         try:
             self.reference_counter.drain_deferred()
+        except Exception:
+            pass
+        # ship the last task-event batch while the GCS link still lives
+        try:
+            tev.flush_all(timeout=1.0)
         except Exception:
             pass
         self.connected = False
@@ -1141,6 +1148,8 @@ class Worker:
             self.pending_tasks[spec["task_id"]] = state
             for oid in return_ids:
                 add_owned(oid, lineage=spec)
+            tev.emit(spec["task_id"], tev.PENDING_SCHEDULING,
+                     name=spec.get("fn_name"), job_id=spec.get("job_id"))
             batch.append((spec, state))
             out.append([ObjectRef(oid, self.address) for oid in return_ids])
         with self._submit_lock:
@@ -1179,9 +1188,13 @@ class Worker:
         return_ids = [ObjectID.for_return(task_id, i)
                       for i in range(num_returns)]
         state = PendingTaskState(spec, spec.get("max_retries", 0), return_ids)
+        state.attempt = int(spec.get("attempt") or 0)
         self.pending_tasks[spec["task_id"]] = state
         for oid in return_ids:
             self.reference_counter.add_owned(oid, lineage=spec)
+        tev.emit(spec["task_id"], tev.PENDING_SCHEDULING,
+                 name=spec.get("fn_name"), job_id=spec.get("job_id"),
+                 attempt=state.attempt or None)
         if reconstruction:
             # the original submission's counts were already removed on the
             # first completion; count the resubmit's arg refs again
@@ -1251,6 +1264,10 @@ class Worker:
                     ser = serialization.serialize_error(err)
                     for oid in state.return_ids:
                         self.memory_store.put(oid, ser.to_bytes())
+                    tev.emit(task_id, tev.FAILED,
+                             name=state.spec.get("fn_name"),
+                             job_id=state.spec.get("job_id"),
+                             error="CANCELLED: never dispatched")
                     state.done = True
                     state.result_event.set()
                     return
@@ -1506,6 +1523,7 @@ class Worker:
                    "NODE_DRAINING") and \
                 state.retries_left != 0:
             state.retries_left -= 1
+            self._bump_attempt(state)
             logger.warning("task %s failed (%s), retrying (%d left)",
                            state.spec["fn_name"], err, state.retries_left)
 
@@ -1536,6 +1554,14 @@ class Worker:
             self.memory_store.put(oid, payload)
         for hex_ref, _ in state.spec.get("arg_refs", []):
             self.reference_counter.remove_submitted(ObjectID.from_hex(hex_ref))
+        # owner-side fatal resolution (cancel, retries exhausted,
+        # unreachable raylet): the task must land terminal in the
+        # state table even when no worker/raylet could report it
+        tev.emit(state.spec.get("task_id"), tev.FAILED,
+                 name=state.spec.get("fn_name"),
+                 job_id=state.spec.get("job_id"),
+                 attempt=state.attempt or None,
+                 error=f"{err}: {reply.get('message', '')}"[:200])
         state.done = True
         state.result_event.set()
 
@@ -1623,6 +1649,7 @@ class Worker:
             if payload.get("app_error") and state.retries_left != 0 and \
                     state.spec.get("retry_exceptions"):
                 state.retries_left -= 1
+                self._bump_attempt(state)
                 protocol.spawn(
                     self._retry(state))
                 return {}
@@ -1640,6 +1667,16 @@ class Worker:
             return {}
         self._on_submit_reply(state, payload)
         return {}
+
+    def _bump_attempt(self, state: PendingTaskState):
+        """A retry restarts the task lifecycle: stamp the new attempt
+        number into the spec (raylet + worker events inherit it) and
+        report the transition back to PENDING_SCHEDULING."""
+        state.attempt += 1
+        state.spec["attempt"] = state.attempt
+        tev.emit(state.spec["task_id"], tev.PENDING_SCHEDULING,
+                 name=state.spec.get("fn_name"),
+                 job_id=state.spec.get("job_id"), attempt=state.attempt)
 
     async def _retry(self, state):
         try:
@@ -1770,6 +1807,11 @@ class Worker:
         app_error = False
         from ray_tpu.util import timeline as _timeline
         _t0 = time.time()
+        _task_err: Optional[str] = None
+        tev.emit(task_hex, tev.RUNNING, name=spec.get("fn_name"),
+                 job_id=spec.get("job_id"), node_id=self.node_id,
+                 worker_pid=os.getpid(), attempt=spec.get("attempt"),
+                 trace_ctx=spec.get("trace_ctx"))
         # adopt the propagated span: child submits from inside this task
         # will parent to it
         self.task_context.trace = spec.get("trace_ctx")
@@ -1802,6 +1844,7 @@ class Worker:
             app_error = True
             err = exc.TaskError.capture(spec["fn_name"], e) \
                 if not isinstance(e, exc.RayTpuError) else e
+            _task_err = f"{type(e).__name__}: {e}"
             ser = serialization.serialize_error(err)
             for i in range(max(1, spec["num_returns"])):
                 oid = ObjectID.for_return(self.current_task_id, i)
@@ -1814,6 +1857,11 @@ class Worker:
                                   time.time(), pid=os.getpid(),
                                   failed=app_error,
                                   trace_ctx=spec.get("trace_ctx"))
+            tev.emit(task_hex,
+                     tev.FAILED if app_error else tev.FINISHED,
+                     name=spec.get("fn_name"), job_id=spec.get("job_id"),
+                     node_id=self.node_id, worker_pid=os.getpid(),
+                     attempt=spec.get("attempt"), error=_task_err)
         if reply is not None:
             # leased task: the RPC reply carries the result (no owner
             # notify, no task_done — the lease holds the resources)

@@ -3,13 +3,20 @@
 Reference analogue: dashboard/dashboard.py + head.py (aiohttp module
 registry) and modules/{node,actor,job,metrics,healthz}. Endpoints:
 
-  GET  /api/cluster_status   resources + node/actor summary
+  GET  /api/cluster_status   resources + node/actor/task summary
   GET  /api/nodes            node table
   GET  /api/actors           actor table
+  GET  /api/tasks            paginated task table (state/name/job_id
+                             filters, limit + continuation token)
+  GET  /api/objects          cluster object listing (per-raylet index)
+  GET  /api/summary/tasks    per-function task aggregation
+  GET  /api/timeline         merged chrome-trace task timeline
+  GET  /api/serve/metrics    live serve panel (queue/shed/p99)
   GET  /api/jobs/            job list      POST /api/jobs/  submit
   GET  /api/jobs/<id>        job info      GET /api/jobs/<id>/logs
   POST /api/jobs/<id>/stop
-  GET  /metrics              Prometheus exposition (util.metrics hub)
+  GET  /metrics              Prometheus exposition (util.metrics hub
+                             + cluster/node/serve gauges)
   GET  /healthz
 """
 
@@ -104,6 +111,10 @@ class DashboardActor:
                         text += _node_gauges(state)
                     except Exception:
                         pass
+                    try:
+                        text += _serve_gauges()
+                    except Exception:
+                        pass
                     return self._text(200, text)
                 if path == "/api/cluster_status":
                     return self._json(200, state.summarize_cluster())
@@ -115,6 +126,43 @@ class DashboardActor:
                 if path == "/api/actors":
                     return self._json(200,
                                       {"actors": state.list_actors()})
+                if path == "/api/tasks":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+
+                    def one(k):
+                        return (q.get(k) or [None])[0]
+                    filters = {k: one(k) for k in
+                               ("state", "name", "job_id", "node_id")
+                               if one(k)}
+                    page = state.list_tasks(
+                        filters=filters or None,
+                        page_size=int(one("limit") or 200),
+                        continuation_token=one("token"))
+                    return self._json(200, {
+                        "tasks": list(page),
+                        "next_token": page.next_token,
+                        "total": page.total,
+                        "dropped": page.dropped})
+                if path == "/api/objects":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    page = state.list_objects(
+                        page_size=int((q.get("limit") or ["200"])[0]),
+                        continuation_token=(q.get("token") or [None])[0])
+                    return self._json(200, {
+                        "objects": list(page),
+                        "next_token": page.next_token,
+                        "total": page.total})
+                if path == "/api/summary/tasks":
+                    return self._json(200, state.summarize_tasks())
+                if path == "/api/timeline":
+                    from ray_tpu.util.timeline import timeline_dump
+                    return self._json(200, {"events": timeline_dump()})
+                if path == "/api/serve/metrics":
+                    from ray_tpu import serve as _serve
+                    return self._json(200,
+                                      {"deployments": _serve.metrics()})
                 if path == "/api/placement_groups":
                     return self._json(
                         200, {"placement_groups":
@@ -233,6 +281,12 @@ def _cluster_gauges(state) -> str:
     g("cluster_nodes_total", s["nodes_total"], "All registered nodes")
     g("cluster_actors_alive", s["actors_alive"], "Alive actors")
     g("cluster_actors_total", s["actors_total"], "All actors")
+    tasks = s.get("tasks") or {}
+    for st, n in sorted((tasks.get("by_state") or {}).items()):
+        lines.append(
+            f'ray_tpu_cluster_tasks{{state="{st}"}} {float(n)}')
+    g("cluster_task_table_dropped", tasks.get("dropped", 0),
+      "Task records evicted past the bounded-table cap")
     for metric, key in (("cluster_resource_total", "cluster_resources"),
                         ("cluster_resource_available",
                          "available_resources")):
@@ -284,6 +338,43 @@ def _node_gauges(state) -> str:
         tpu = n.get("tpu", {})
         for k in ("num_chips", "chips_available"):
             g(f"tpu_{k}", nid, tpu.get(k, 0), f"TPU {k}")
+    return "\n" + "\n".join(lines) + "\n" if lines else ""
+
+
+def _serve_gauges() -> str:
+    """Per-deployment serve data-plane gauges (queue depth, shed
+    total/rate, p99/EWMA service time) from the controller's
+    replica_load telemetry — the live serve panel, in exposition
+    format. Empty when serve isn't running."""
+    from ray_tpu import serve as _serve
+    mets = _serve.metrics()
+    if not mets:
+        return ""
+    lines = []
+    seen_help = set()
+
+    def g(name, dep, value, help_):
+        full = f"ray_tpu_serve_{name}"
+        if full not in seen_help:
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+            seen_help.add(full)
+        lines.append(f'{full}{{deployment="{dep}"}} {float(value)}')
+
+    for dep, m in sorted(mets.items()):
+        g("replicas", dep, m.get("replicas") or 0, "live replicas")
+        g("queue_len", dep, m.get("queue_len") or 0,
+          "queued + ongoing requests across replicas")
+        g("shed_total", dep, m.get("shed_total") or 0,
+          "requests shed (backpressure) total")
+        g("shed_rate_per_s", dep, m.get("shed_rate_per_s") or 0,
+          "shed rate since the previous scrape")
+        g("requests_total", dep, m.get("requests_total") or 0,
+          "requests admitted total")
+        g("p99_seconds", dep, m.get("p99_s") or 0,
+          "p99 service time over the replica latency reservoirs")
+        g("ewma_seconds", dep, m.get("ewma_s") or 0,
+          "EWMA service time (slowest replica)")
     return "\n" + "\n".join(lines) + "\n" if lines else ""
 
 

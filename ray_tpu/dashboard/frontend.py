@@ -1,6 +1,8 @@
 """Single-file dashboard frontend (reference: the dashboard/client React
 app, scaled to a dependency-free page served by the same process). Polls
-the REST endpoints: cluster status, nodes, actors, jobs, events, logs.
+the REST endpoints: cluster status, nodes, serve metrics, tasks (paged +
+state filter), actors, jobs, events, logs, and renders the task
+timeline from the merged chrome-trace events.
 """
 
 INDEX_HTML = """<!doctype html>
@@ -32,13 +34,26 @@ INDEX_HTML = """<!doctype html>
            font-variant-numeric: tabular-nums; white-space: nowrap; }
   th { opacity: .7; font-weight: 600; }
   td.msg { white-space: normal; }
-  .sev-ERROR, .sev-FATAL { color: #c62828; font-weight: 600; }
+  .sev-ERROR, .sev-FATAL, .st-FAILED { color: #c62828; font-weight: 600; }
   .sev-WARNING { color: #b26a00; font-weight: 600; }
+  .st-FINISHED { color: #2e7d32; }
+  .st-RUNNING { color: #1565c0; font-weight: 600; }
   pre { background: color-mix(in srgb, CanvasText 6%, transparent);
         padding: 8px; border-radius: 6px; max-height: 320px;
         overflow: auto; }
   a { color: inherit; }
-  select { font: inherit; }
+  select, button { font: inherit; }
+  .bar-row { display: flex; align-items: center; height: 14px; }
+  .bar-label { width: 180px; flex: none; overflow: hidden;
+               text-overflow: ellipsis; opacity: .7; font-size: 11px; }
+  .bar-lane { position: relative; flex: 1; height: 12px; }
+  .bar { position: absolute; height: 10px; top: 1px; border-radius: 2px;
+         background: #1565c0; min-width: 2px; opacity: .85; }
+  .bar.failed { background: #c62828; }
+  #timeline { max-height: 420px; overflow: auto; border: 1px solid
+              color-mix(in srgb, CanvasText 12%, transparent);
+              border-radius: 6px; padding: 6px; }
+  .muted { opacity: .65; }
 </style>
 </head>
 <body>
@@ -48,7 +63,30 @@ INDEX_HTML = """<!doctype html>
 </header>
 <main>
   <section><h2>Cluster</h2><div class="tiles" id="tiles"></div></section>
+  <section><h2>Serve</h2><table id="serve"></table>
+    <div class="muted" id="serve-empty"></div></section>
   <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section>
+    <h2>Tasks</h2>
+    <div style="margin-bottom:6px">
+      state: <select id="taskstate">
+        <option value="">(all)</option>
+        <option>PENDING_SCHEDULING</option>
+        <option>PENDING_NODE_ASSIGNMENT</option>
+        <option>RUNNING</option>
+        <option>FINISHED</option>
+        <option>FAILED</option>
+      </select>
+      <span class="muted" id="taskmeta"></span>
+    </div>
+    <table id="tasks"></table>
+  </section>
+  <section>
+    <h2>Task timeline</h2>
+    <button id="tl-load">load timeline</button>
+    <span class="muted" id="tl-meta"></span>
+    <div id="timeline"></div>
+  </section>
   <section><h2>Actors</h2><table id="actors"></table></section>
   <section><h2>Jobs</h2><table id="jobs"></table></section>
   <section><h2>Events</h2><table id="events"></table></section>
@@ -67,17 +105,35 @@ const row = cells => "<tr>" + cells.map(c => "<td" +
   esc(c && c.v !== undefined ? c.v : c) + "</td>").join("") + "</tr>";
 const head = cols => "<tr>" + cols.map(c => `<th>${c}</th>`).join("")
   + "</tr>";
+const ms = s => s == null ? "-" : (s * 1000).toFixed(1) + "ms";
 
 async function refresh() {
   try {
     const s = await get("/api/cluster_status");
     const res = s.cluster_resources || {};
+    const t = s.tasks || {};
+    const by = t.by_state || {};
     document.getElementById("tiles").innerHTML = [
       ["nodes alive", s.nodes_alive + "/" + s.nodes_total],
       ["actors alive", s.actors_alive + "/" + s.actors_total],
       ["CPU", res.CPU ?? 0], ["TPU", res.TPU ?? 0],
+      ["tasks running", by.RUNNING ?? 0],
+      ["tasks finished", by.FINISHED ?? 0],
+      ["tasks failed", by.FAILED ?? 0],
     ].map(([k, v]) => `<div class="tile"><b>${esc(v)}</b>${esc(k)}
       </div>`).join("");
+
+    const serve = (await get("/api/serve/metrics")).deployments || {};
+    const deps = Object.entries(serve);
+    document.getElementById("serve-empty").textContent =
+      deps.length ? "" : "(no serve deployments)";
+    document.getElementById("serve").innerHTML = !deps.length ? "" :
+      head(["deployment", "status", "replicas", "queue depth",
+            "shed total", "shed/s", "requests", "p99", "ewma"]) +
+      deps.map(([n, m]) => row([n, m.status,
+        (m.replicas ?? 0) + "/" + (m.target_replicas ?? 0),
+        m.queue_len ?? 0, m.shed_total ?? 0, m.shed_rate_per_s ?? 0,
+        m.requests_total ?? 0, ms(m.p99_s), ms(m.ewma_s)])).join("");
 
     const nodes = (await get("/api/nodes")).nodes || [];
     const stats = (await get("/api/nodes/stats")).nodes || [];
@@ -98,6 +154,22 @@ async function refresh() {
           sc.workers_alive ?? "-",
           os_.spilled_objects ?? "-",
           JSON.stringify(n.resources)]); }).join("");
+
+    const st = document.getElementById("taskstate").value;
+    const td = await get("/api/tasks?limit=100" +
+                         (st ? "&state=" + st : ""));
+    const tasks = td.tasks || [];
+    document.getElementById("taskmeta").textContent =
+      `${tasks.length} of ${td.total ?? "?"} shown` +
+      (td.dropped ? ` · ${td.dropped} evicted (table cap)` : "");
+    document.getElementById("tasks").innerHTML =
+      head(["task", "name", "state", "attempt", "node", "pid",
+            "duration", "error"]) +
+      tasks.map(x => row([x.task_id.slice(0, 12), x.name || "-",
+        {v: x.state, cls: "st-" + x.state}, x.attempt || 0,
+        (x.node_id || "").slice(0, 8) || "-", x.worker_pid ?? "-",
+        x.duration_s != null ? ms(x.duration_s) : "-",
+        {v: x.error || "", cls: "msg"}])).join("");
 
     const actors = (await get("/api/actors")).actors || [];
     document.getElementById("actors").innerHTML =
@@ -138,6 +210,43 @@ async function refresh() {
     document.getElementById("updated").textContent = "error: " + e;
   }
 }
+
+// Task timeline: the merged chrome-trace ('X' complete events, one
+// lane per pid:tid), rendered as proportional bars. On demand — the
+// trace merge walks every process's buffer.
+async function loadTimeline() {
+  const box = document.getElementById("timeline");
+  box.innerHTML = "loading…";
+  try {
+    const evs = ((await get("/api/timeline")).events || [])
+      .filter(e => e.ph === "X" && e.dur != null);
+    if (!evs.length) { box.innerHTML = "(no task events yet)"; return; }
+    evs.sort((a, b) => a.ts - b.ts);
+    const shown = evs.slice(-1000);
+    const t0 = Math.min(...shown.map(e => e.ts));
+    const t1 = Math.max(...shown.map(e => e.ts + e.dur));
+    const span = Math.max(t1 - t0, 1);
+    document.getElementById("tl-meta").textContent =
+      `${shown.length}${evs.length > shown.length ? " (latest) of " +
+        evs.length : ""} tasks · ${(span / 1e6).toFixed(2)}s window`;
+    const lanes = new Map();
+    for (const e of shown) {
+      const key = `pid ${e.pid} · tid ${e.tid}`;
+      if (!lanes.has(key)) lanes.set(key, []);
+      lanes.get(key).push(e);
+    }
+    box.innerHTML = [...lanes.entries()].map(([key, es]) =>
+      `<div class="bar-row"><div class="bar-label">${esc(key)}</div>` +
+      `<div class="bar-lane">` + es.map(e =>
+        `<div class="bar${e.cname === "terrible" ? " failed" : ""}"` +
+        ` style="left:${(100 * (e.ts - t0) / span).toFixed(3)}%;` +
+        `width:${(100 * e.dur / span).toFixed(3)}%"` +
+        ` title="${esc(e.name)} ${(e.dur / 1000).toFixed(2)}ms"></div>`
+      ).join("") + `</div></div>`).join("");
+  } catch (e) { box.innerHTML = "error: " + esc(e); }
+}
+document.getElementById("tl-load").onclick = loadTimeline;
+document.getElementById("taskstate").onchange = refresh;
 refresh();
 setInterval(refresh, 4000);
 </script>

@@ -1,7 +1,10 @@
-from ray_tpu.experimental.state.api import (list_actors, list_jobs,
-                                            list_nodes,
+from ray_tpu.experimental.state.api import (StateListResult, list_actors,
+                                            list_jobs, list_nodes,
+                                            list_objects,
                                             list_placement_groups,
-                                            summarize_cluster)
+                                            list_tasks, summarize_cluster,
+                                            summarize_tasks)
 
-__all__ = ["list_actors", "list_jobs", "list_nodes",
-           "list_placement_groups", "summarize_cluster"]
+__all__ = ["StateListResult", "list_actors", "list_jobs", "list_nodes",
+           "list_objects", "list_placement_groups", "list_tasks",
+           "summarize_cluster", "summarize_tasks"]

@@ -1,8 +1,13 @@
-"""Cluster state API — `list actors/nodes/jobs/placement groups`.
+"""Cluster state API — `list tasks/objects/actors/nodes/jobs/placement
+groups` with cursor pagination and server-side filtering.
 
 Reference analogue: python/ray/experimental/state/api.py (+ the
 dashboard-side state_aggregator.py). Queries go straight to the GCS
-over the driver's existing connection.
+over the driver's existing connection. Every list call is PAGED on the
+wire (``limit`` + ``continuation_token`` + filter pushdown): a single
+RPC never carries a full table, and the client either walks pages
+transparently (default) or hands control of the cursor to the caller
+(pass ``page_size``/``continuation_token``).
 """
 
 from __future__ import annotations
@@ -10,6 +15,24 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import worker as _worker_mod
+
+# page size used when the client auto-walks the cursor for callers
+# that just want "the list"
+_AUTO_PAGE = 1000
+
+
+class StateListResult(list):
+    """A plain list of rows plus the paging metadata that rode the last
+    reply: ``next_token`` (None = exhausted), ``total`` (server-side
+    filtered count), ``dropped`` (records the bounded server table has
+    evicted — >0 means history, not live state, is missing)."""
+
+    def __init__(self, items=(), next_token: Optional[str] = None,
+                 total: Optional[int] = None, dropped: int = 0):
+        super().__init__(items)
+        self.next_token = next_token
+        self.total = total
+        self.dropped = dropped
 
 
 def _gcs_call(method: str, payload: Optional[dict] = None,
@@ -20,14 +43,75 @@ def _gcs_call(method: str, payload: Optional[dict] = None,
     return w.call_sync(w.gcs, method, payload or {}, timeout=timeout)
 
 
-def list_nodes(filters: Optional[Dict[str, Any]] = None
-               ) -> List[Dict[str, Any]]:
-    return _apply_filters(_gcs_call("get_nodes"), filters)
+def _list_paged(method: str, filters: Optional[Dict[str, Any]],
+                limit: Optional[int], continuation_token: Optional[str],
+                page_size: Optional[int], timeout: float = 30,
+                extra: Optional[dict] = None) -> StateListResult:
+    """Shared cursor walker. Explicit ``page_size``/``continuation_
+    token`` = manual paging (ONE page per call, resume via
+    ``.next_token``); otherwise pages are walked transparently until
+    ``limit`` (or the table) is exhausted."""
+    manual = page_size is not None or continuation_token is not None
+    token = continuation_token
+    out = StateListResult()
+    while True:
+        want = page_size or _AUTO_PAGE
+        if limit is not None:
+            want = min(want, max(1, limit - len(out)))
+        payload = {"paged": True, "limit": want,
+                   "continuation_token": token, **(extra or {})}
+        if filters:
+            payload["filters"] = filters
+        r = _gcs_call(method, payload, timeout=timeout)
+        out.extend(r.get("items") or ())
+        out.next_token = r.get("next_token")
+        out.total = r.get("total", out.total)
+        out.dropped = r.get("dropped", out.dropped)
+        token = out.next_token
+        if manual or token is None or \
+                (limit is not None and len(out) >= limit):
+            return out
 
 
-def list_actors(filters: Optional[Dict[str, Any]] = None
-                ) -> List[Dict[str, Any]]:
-    return _apply_filters(_gcs_call("list_actors"), filters)
+def list_nodes(filters: Optional[Dict[str, Any]] = None,
+               limit: Optional[int] = None,
+               continuation_token: Optional[str] = None,
+               page_size: Optional[int] = None) -> StateListResult:
+    return _list_paged("get_nodes", filters, limit, continuation_token,
+                       page_size)
+
+
+def list_actors(filters: Optional[Dict[str, Any]] = None,
+                limit: Optional[int] = None,
+                continuation_token: Optional[str] = None,
+                page_size: Optional[int] = None) -> StateListResult:
+    return _list_paged("list_actors", filters, limit, continuation_token,
+                       page_size)
+
+
+def list_tasks(filters: Optional[Dict[str, Any]] = None,
+               limit: Optional[int] = None,
+               continuation_token: Optional[str] = None,
+               page_size: Optional[int] = None) -> StateListResult:
+    """Cluster-wide task listing from the GCS's bounded task table
+    (fed by the task-event pipeline). Filter keys: state, name,
+    job_id, node_id, task_id — pushed down to the server. The result's
+    ``dropped`` reports table evictions (cap exceeded)."""
+    return _list_paged("list_tasks", filters, limit, continuation_token,
+                       page_size)
+
+
+def list_objects(filters: Optional[Dict[str, Any]] = None,
+                 limit: Optional[int] = None,
+                 continuation_token: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 node_id: Optional[str] = None) -> StateListResult:
+    """Cluster object listing aggregated from per-raylet plasma
+    indexes (pinned + spilled primaries); each row carries locations,
+    owner, size. ``node_id`` narrows the fan-out to one raylet."""
+    return _list_paged("list_objects", filters, limit,
+                       continuation_token, page_size,
+                       extra={"node_id": node_id} if node_id else None)
 
 
 def profile_stacks(node_id: Optional[str] = None,
@@ -63,14 +147,21 @@ def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     return _gcs_call("get_node_stats", {"node_id": node_id})["nodes"]
 
 
-def list_jobs(filters: Optional[Dict[str, Any]] = None
-              ) -> List[Dict[str, Any]]:
-    return _apply_filters(_gcs_call("get_jobs"), filters)
+def list_jobs(filters: Optional[Dict[str, Any]] = None,
+              limit: Optional[int] = None,
+              continuation_token: Optional[str] = None,
+              page_size: Optional[int] = None) -> StateListResult:
+    return _list_paged("get_jobs", filters, limit, continuation_token,
+                       page_size)
 
 
-def list_placement_groups(filters: Optional[Dict[str, Any]] = None
-                          ) -> List[Dict[str, Any]]:
-    return _apply_filters(_gcs_call("list_placement_groups"), filters)
+def list_placement_groups(filters: Optional[Dict[str, Any]] = None,
+                          limit: Optional[int] = None,
+                          continuation_token: Optional[str] = None,
+                          page_size: Optional[int] = None
+                          ) -> StateListResult:
+    return _list_paged("list_placement_groups", filters, limit,
+                       continuation_token, page_size)
 
 
 def list_cluster_events(limit: int = 200,
@@ -116,23 +207,15 @@ def get_log(filename: str, tail: int = 1000) -> str:
 
 
 def summarize_cluster() -> Dict[str, Any]:
-    nodes = list_nodes()
-    actors = list_actors()
-    return {
-        "nodes_total": len(nodes),
-        "nodes_alive": sum(1 for n in nodes if n.get("alive")),
-        "actors_total": len(actors),
-        "actors_alive": sum(1 for a in actors
-                            if a.get("state") == "ALIVE"),
-        "cluster_resources": _gcs_call("cluster_resources"),
-        "available_resources": _gcs_call("available_resources"),
-    }
+    """One-RPC cluster summary: the GCS counts its own tables
+    (node/actor/job/PG/task counts + resource totals) instead of
+    shipping them whole just to be len()'d client-side."""
+    return _gcs_call("summarize")
 
 
-def _apply_filters(rows: List[Dict[str, Any]],
-                   filters: Optional[Dict[str, Any]]
-                   ) -> List[Dict[str, Any]]:
-    if not filters:
-        return rows
-    return [r for r in rows
-            if all(r.get(k) == v for k, v in filters.items())]
+def summarize_tasks() -> Dict[str, Any]:
+    """Per-function task aggregation (`ray-tpu summary tasks`):
+    {summary: [{name, count, by_state, mean_duration_s}, ...],
+    by_state, dropped, ...} computed GCS-side over the bounded task
+    table."""
+    return _gcs_call("summarize_tasks")

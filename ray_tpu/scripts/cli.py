@@ -149,6 +149,95 @@ def cmd_job_stop(args):
     print(f"stopped {args.job_id}")
 
 
+def _print_table(rows, cols):
+    """Aligned plain-text table (no deps); values stringified, None
+    printed as '-'."""
+    def cell(r, c):
+        v = r.get(c)
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.6g}"
+        if isinstance(v, (dict, list)):
+            return json.dumps(v)
+        return str(v)
+    table = [[cell(r, c) for c in cols] for r in rows]
+    widths = [max(len(c), *(len(t[i]) for t in table)) if table
+              else len(c) for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for t in table:
+        print("  ".join(v.ljust(w) for v, w in zip(t, widths)))
+
+
+_LIST_COLUMNS = {
+    "tasks": ["task_id", "name", "state", "attempt", "node_id",
+              "worker_pid", "duration_s", "error"],
+    "objects": ["object_id", "size_bytes", "pinned", "spilled",
+                "locations", "owner"],
+    "actors": ["actor_id", "class_name", "state", "name",
+               "num_restarts", "node_id"],
+    "nodes": ["node_id", "alive", "draining", "is_head", "resources",
+              "available"],
+    "jobs": ["job_id", "status", "namespace", "driver_pid"],
+    "placement-groups": ["pg_id", "state", "strategy", "name"],
+}
+
+
+def cmd_list(args):
+    """`ray-tpu list tasks|objects|actors|nodes|jobs|placement-groups`
+    (reference: `ray list ...` backed by the state API): paginated,
+    server-side filtered listings."""
+    _connect(args.address)
+    from ray_tpu.experimental.state import api as state
+    filters = {}
+    for f in args.filter or ():
+        k, sep, v = f.partition("=")
+        if not sep:
+            sys.exit(f"--filter wants key=value, got {f!r}")
+        filters[k] = v
+    if getattr(args, "state", None):
+        filters["state"] = args.state
+    fn = {"tasks": state.list_tasks, "objects": state.list_objects,
+          "actors": state.list_actors, "nodes": state.list_nodes,
+          "jobs": state.list_jobs,
+          "placement-groups": state.list_placement_groups}[args.resource]
+    rows = fn(filters=filters or None, limit=args.limit)
+    if args.json:
+        print(json.dumps(list(rows), indent=2, default=str))
+    else:
+        cols = _LIST_COLUMNS[args.resource]
+        short = {"task_id", "actor_id", "node_id", "object_id", "pg_id"}
+        view = [{c: (str(r.get(c))[:16] if c in short and r.get(c)
+                     else r.get(c)) for c in cols} for r in rows]
+        _print_table(view, cols)
+    total = rows.total if rows.total is not None else len(rows)
+    note = f"{len(rows)} shown / {total} matched"
+    if rows.dropped:
+        note += f" ({rows.dropped} evicted past the table cap)"
+    if rows.next_token:
+        note += " — more available (raise --limit)"
+    print(note, file=sys.stderr)
+
+
+def cmd_summary(args):
+    """`ray-tpu summary tasks`: per-function aggregation computed
+    GCS-side over the bounded task table."""
+    _connect(args.address)
+    from ray_tpu.experimental.state import api as state
+    s = state.summarize_tasks()
+    rows = [{"name": a["name"], "count": a["count"],
+             "mean_duration_s": a.get("mean_duration_s"),
+             **{st: a["by_state"].get(st, 0)
+                for st in ("RUNNING", "FINISHED", "FAILED")}}
+            for a in s.get("summary", ())]
+    _print_table(rows, ["name", "count", "RUNNING", "FINISHED",
+                        "FAILED", "mean_duration_s"])
+    print(f"table: {s.get('total', 0)} tracked, "
+          f"{s.get('dropped', 0)} evicted, "
+          f"{s.get('events_dropped', 0)} events dropped at source",
+          file=sys.stderr)
+
+
 def cmd_events(args):
     _connect(args.address)
     from ray_tpu.experimental.state import api as state
@@ -291,6 +380,26 @@ def main(argv=None):
     sp = jsub.add_parser("list")
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_job_list)
+
+    sp = sub.add_parser(
+        "list", help="paginated state listings (tasks/objects/...)")
+    sp.add_argument("resource",
+                    choices=["tasks", "objects", "actors", "nodes",
+                             "jobs", "placement-groups"])
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--state", default=None,
+                    help="shorthand for --filter state=...")
+    sp.add_argument("--filter", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="server-side equality filter (repeatable)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(func=cmd_list)
+
+    sp = sub.add_parser("summary", help="aggregated state summaries")
+    sp.add_argument("what", choices=["tasks"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(func=cmd_summary)
 
     sp = sub.add_parser("events", help="structured cluster events")
     sp.add_argument("--address", default=None)

@@ -9,7 +9,7 @@ compiles once per bucket, not per batch size.
 from ray_tpu.serve.api import (Application, Deployment, delete,
                                delete_application, deployment,
                                get_deployment_handle, list_applications,
-                               run, shutdown, start, status)
+                               metrics, run, shutdown, start, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.exceptions import (BatchSubmitTimeoutError,
                                       ReplicaOverloadedError)
@@ -18,8 +18,8 @@ from ray_tpu.serve.ingress import APIRouter, ingress
 from ray_tpu.serve._private.autoscaling import AutoscalingConfig
 
 __all__ = [
-    "deployment", "run", "start", "shutdown", "status", "delete",
-    "delete_application", "list_applications",
+    "deployment", "run", "start", "shutdown", "status", "metrics",
+    "delete", "delete_application", "list_applications",
     "get_deployment_handle", "Deployment", "Application",
     "DeploymentHandle", "batch", "AutoscalingConfig",
     "APIRouter", "ingress",

@@ -89,6 +89,10 @@ class ReplicaActor:
         self._latency_sum = 0.0
         self._ewma_s = 0.0
         self._have_ewma = False
+        # bounded latency reservoir for tail quantiles (p99 in the
+        # dashboard serve panel): last 512 service times, O(1) record
+        from collections import deque
+        self._lat_ring = deque(maxlen=512)
         if user_config is not None:
             self.reconfigure(user_config)
         # bucket-prewarm hook: a callable may define __serve_prewarm__
@@ -163,6 +167,7 @@ class ReplicaActor:
                     self._ewma_s += _EWMA_ALPHA * (dt - self._ewma_s)
                 else:
                     self._ewma_s, self._have_ewma = dt, True
+                self._lat_ring.append(dt)
 
     # ---- control plane ----
 
@@ -182,8 +187,20 @@ class ReplicaActor:
                 "ewma_s": self._ewma_s,
                 "shed": self._total_shed,
                 "draining": self._draining,
+                "total_requests": self._total_requests,
+                "total_errors": self._total_errors,
+                "p99_s": self._quantile(0.99),
                 "ts": time.time(),
             }
+
+    def _quantile(self, q: float) -> float:
+        """Tail quantile over the bounded reservoir (caller holds the
+        lock or tolerates a racy read — the ring is append-only)."""
+        if not self._lat_ring:
+            return 0.0
+        vals = sorted(self._lat_ring)
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
 
     def get_replica_metadata(self) -> Dict[str, Any]:
         """Identity for controller re-adoption (orphan sweep after a
@@ -210,6 +227,8 @@ class ReplicaActor:
                 "total_shed": self._total_shed,
                 "latency_sum_s": self._latency_sum,
                 "ewma_service_time_s": self._ewma_s,
+                "p50_s": self._quantile(0.50),
+                "p99_s": self._quantile(0.99),
                 "max_concurrent_queries": self._max_concurrent,
                 "max_queued_requests": self._max_queued,
             }

@@ -327,6 +327,19 @@ def status() -> Dict[str, Any]:
         return {}
 
 
+def metrics() -> Dict[str, Any]:
+    """Live per-deployment data-plane metrics (queue depth, shed
+    total/rate, p99) from the controller's replica_load telemetry —
+    what the dashboard serve panel and /metrics render. Empty dict
+    when serve isn't running."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return ray_tpu.get(
+            controller.get_serve_metrics.remote(), timeout=30.0)
+    except Exception:
+        return {}
+
+
 def delete(names: Union[str, List[str]]):
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     if isinstance(names, str):

@@ -153,6 +153,9 @@ class ServeController:
         self._last_error: Optional[str] = None   # control-loop level
         self._last_errors: Dict[str, str] = {}   # per-deployment
         self._last_load_table: Dict[str, Any] = {}
+        # shed-rate window: deployment -> (ts, total_shed) at the
+        # previous metrics read (get_serve_metrics computes sheds/s)
+        self._shed_prev: Dict[str, Any] = {}
         self._last_published_table: Optional[Dict[str, Any]] = None
         self._replica_nodes: Dict[str, str] = {}  # replica hex -> node id
         self._draining_nodes: Dict[str, float] = {}  # node id -> deadline
@@ -457,6 +460,48 @@ class ServeController:
                     out[name]["last_controller_error"] = \
                         self._last_errors[name]
             return out
+
+    def get_serve_metrics(self) -> Dict[str, Any]:
+        """Live per-deployment data-plane metrics for the dashboard /
+        Prometheus: queue depth (sum over replicas), shed totals +
+        shed rate since the previous read, p99/EWMA service time —
+        all from the ``replica_load`` telemetry the controller already
+        collects every metrics tick (no extra replica RPCs here)."""
+        statuses = self.get_deployment_statuses()
+        now = time.time()
+        out: Dict[str, Any] = {}
+        for name, st in statuses.items():
+            loads = (self._last_load_table or {}).get(name, {})
+            queue_len = sum(v.get("queue_len", 0) for v in loads.values())
+            shed_total = sum(v.get("shed", 0) for v in loads.values())
+            requests_total = sum(v.get("total_requests", 0)
+                                 for v in loads.values())
+            errors_total = sum(v.get("total_errors", 0)
+                               for v in loads.values())
+            p99 = max((v.get("p99_s", 0.0) for v in loads.values()),
+                      default=0.0)
+            ewma = max((v.get("ewma_s", 0.0) for v in loads.values()),
+                       default=0.0)
+            prev = self._shed_prev.get(name)
+            shed_rate = 0.0
+            if prev and now > prev[0]:
+                shed_rate = max(0.0, (shed_total - prev[1])
+                                / (now - prev[0]))
+            self._shed_prev[name] = (now, shed_total)
+            out[name] = {
+                "app": st.get("app"),
+                "status": st.get("status"),
+                "replicas": st.get("live_replicas"),
+                "target_replicas": st.get("target_replicas"),
+                "queue_len": queue_len,
+                "shed_total": shed_total,
+                "shed_rate_per_s": round(shed_rate, 3),
+                "requests_total": requests_total,
+                "errors_total": errors_total,
+                "p99_s": round(p99, 6),
+                "ewma_s": round(ewma, 6),
+            }
+        return out
 
     def get_controller_info(self) -> Dict[str, Any]:
         """Introspection for tests/bench/ops: restart identity, journal

@@ -5,10 +5,20 @@ stats pipeline (SURVEY.md §5.5). Here metrics aggregate in a named
 metrics-hub actor and export in Prometheus text format
 (``ray_tpu.util.metrics.prometheus_text()``), which the dashboard
 scrapes.
+
+Recording is PRE-AGGREGATED process-locally (reference: the per-core-
+worker OpenCensus view aggregation before export): each data point
+folds into a local table under a plain lock, and a background flusher
+ships ONE ``record_batch`` actor call per interval
+(``RTPU_METRICS_FLUSH_S``, default 1 s). A hot loop incrementing a
+Counter therefore costs a dict update, not a dispatch-plane message
+per point. ``RTPU_METRICS_SYNC=1`` restores the old one-call-per-point
+behavior (tests that assert immediately after recording).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -17,6 +27,19 @@ import ray_tpu
 
 _HUB_NAME = "METRICS_HUB"
 _local_lock = threading.Lock()
+
+# process-local pre-aggregation buffer: (name, sorted-tags) -> entry
+_pending: Dict[Tuple[str, tuple], dict] = {}
+_pending_lock = threading.Lock()
+_flusher_started = False
+
+
+def _sync_mode() -> bool:
+    return os.environ.get("RTPU_METRICS_SYNC") == "1"
+
+
+def _flush_interval() -> float:
+    return float(os.environ.get("RTPU_METRICS_FLUSH_S", 1.0))
 
 
 class _MetricsHub:
@@ -52,6 +75,36 @@ class _MetricsHub:
                         break
                 else:
                     m["buckets"][-1] += 1
+
+    def record_batch(self, entries: List[dict]):
+        """Apply pre-aggregated per-process entries in one call: a
+        counter entry carries the summed delta, a gauge the last
+        value, a histogram its locally-bucketed counts + sum."""
+        for e in entries:
+            key = (e["name"], tuple(sorted((e.get("tags")
+                                            or {}).items())))
+            boundaries = e.get("boundaries") or []
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = {"name": e["name"], "kind": e["kind"],
+                         "tags": e.get("tags") or {},
+                         "description": e.get("description", ""),
+                         "value": 0.0, "count": 0, "sum": 0.0,
+                         "boundaries": boundaries,
+                         "buckets": [0] * (len(boundaries) + 1)}
+                    self._metrics[key] = m
+                kind = e["kind"]
+                if kind == "counter":
+                    m["value"] += e.get("value", 0.0)
+                elif kind == "gauge":
+                    m["value"] = e.get("value", 0.0)
+                else:  # histogram: merge bucketed counts
+                    m["count"] += e.get("count", 0)
+                    m["sum"] += e.get("sum", 0.0)
+                    for i, c in enumerate(e.get("buckets") or []):
+                        if i < len(m["buckets"]):
+                            m["buckets"][i] += c
 
     def dump(self) -> List[dict]:
         with self._lock:
@@ -92,10 +145,38 @@ class _Metric:
 
     def _record(self, value: float, tags: Optional[Dict[str, str]]):
         merged = {**self._default_tags, **(tags or {})}
-        # fire-and-forget to the hub
-        _hub().record.remote(self._name, self.KIND, float(value),
-                             merged, self._description,
-                             self._boundaries)
+        if _sync_mode():
+            # escape hatch: one fire-and-forget per point (tests)
+            _hub().record.remote(self._name, self.KIND, float(value),
+                                 merged, self._description,
+                                 self._boundaries)
+            return
+        # pre-aggregate locally; the flusher ships one batch per tick
+        key = (self._name, tuple(sorted(merged.items())))
+        value = float(value)
+        with _pending_lock:
+            e = _pending.get(key)
+            if e is None:
+                e = {"name": self._name, "kind": self.KIND,
+                     "tags": merged, "description": self._description,
+                     "boundaries": self._boundaries,
+                     "value": 0.0, "count": 0, "sum": 0.0,
+                     "buckets": [0] * (len(self._boundaries or []) + 1)}
+                _pending[key] = e
+            if self.KIND == "counter":
+                e["value"] += value
+            elif self.KIND == "gauge":
+                e["value"] = value
+            else:  # histogram: bucket locally
+                e["count"] += 1
+                e["sum"] += value
+                for i, b in enumerate(self._boundaries or []):
+                    if value <= b:
+                        e["buckets"][i] += 1
+                        break
+                else:
+                    e["buckets"][-1] += 1
+        _ensure_flusher()
 
 
 class Counter(_Metric):
@@ -127,7 +208,53 @@ class Histogram(_Metric):
         self._record(value, tags)
 
 
+def _drain_pending() -> List[dict]:
+    with _pending_lock:
+        entries = list(_pending.values())
+        _pending.clear()
+    return entries
+
+
+def flush_metrics(sync: bool = True):
+    """Ship the process-local aggregation buffer to the hub now. With
+    ``sync`` the call is awaited so a dump immediately after sees the
+    data; the background flusher uses fire-and-forget."""
+    entries = _drain_pending()
+    if not entries:
+        return
+    try:
+        ref = _hub().record_batch.remote(entries)
+        if sync:
+            ray_tpu.get(ref, timeout=30.0)
+    except Exception:
+        # hub unreachable (e.g. shutdown racing the flusher): requeue
+        # nothing — metrics are lossy telemetry, not a ledger
+        pass
+
+
+def _ensure_flusher():
+    global _flusher_started
+    if _flusher_started:
+        return
+    with _local_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(_flush_interval())
+            try:
+                flush_metrics(sync=False)
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, daemon=True,
+                     name="rtpu-metrics-flush").start()
+
+
 def dump_metrics() -> List[dict]:
+    flush_metrics(sync=True)
     return ray_tpu.get(_hub().dump.remote(), timeout=30.0)
 
 

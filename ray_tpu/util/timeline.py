@@ -90,7 +90,13 @@ def flush():
     when nothing new was recorded since the previous push). Dirty check
     uses the monotonic recorded-event counter — the buffer *length*
     plateaus at the ring cap, which would make a length-based check a
-    permanent no-op once 10k events accumulate."""
+    permanent no-op once 10k events accumulate.
+
+    The cursor only advances AFTER the kv_put succeeds: advancing it
+    first turned any failed push (GCS restart window, timeout) into
+    silently dropping every event recorded since the last successful
+    flush — the next flush would see a clean dirty-check and never
+    retry them."""
     global _last_pushed_total
     from ray_tpu._private import worker as worker_mod
     w = worker_mod._global_worker
@@ -100,14 +106,17 @@ def flush():
         if _total_recorded == _last_pushed_total:
             return
         events = list(_events)
-        _last_pushed_total = _total_recorded
+        snapshot = _total_recorded
     try:
         w.call_sync(w.gcs, "kv_put", {
             "key": f"@timeline/{w.node_id[:8]}-{os.getpid()}",
             "value": json.dumps(events).encode(),
             "overwrite": True}, timeout=5)
     except Exception:
-        pass
+        return  # cursor untouched; the next flush retries these events
+    with _lock:
+        # concurrent flushes may complete out of order; never regress
+        _last_pushed_total = max(_last_pushed_total, snapshot)
 
 
 def timeline_dump() -> List[Dict[str, Any]]:

@@ -107,6 +107,44 @@ def test_10k_task_backlog_drains(scale_cluster):
     print(f"\ndrained {N_TASKS} tasks in {dt:.1f}s "
           f"= {N_TASKS / dt:.0f} tasks/s end-to-end")
 
+    # observability gate: the drained backlog must be LISTABLE —
+    # cursor pages stay bounded (no full-table RPC), their union
+    # covers every drained task, no duplicates, and the head's table
+    # stayed within its cap (drop counter visible, not silent loss)
+    from ray_tpu.experimental.state import api as state
+    page_cap = 1000
+    deadline = time.time() + 180
+    seen = set()
+    pages = 0
+    while time.time() < deadline:
+        seen.clear()
+        pages = 0
+        token = None
+        dropped = 0
+        while True:
+            page = state.list_tasks(
+                filters={"name": "bump", "state": "FINISHED"},
+                page_size=page_cap, continuation_token=token)
+            assert len(page) <= page_cap
+            seen.update(t["task_id"] for t in page)
+            pages += 1
+            dropped = page.dropped
+            token = page.next_token
+            if token is None:
+                break
+        if len(seen) + dropped >= N_TASKS:
+            break
+        time.sleep(1.0)
+    assert len(seen) + dropped >= N_TASKS, \
+        f"listed {len(seen)} of {N_TASKS} drained tasks " \
+        f"(+{dropped} evicted)"
+    assert pages >= max(1, min(N_TASKS, len(seen)) // page_cap), \
+        "listing did not actually paginate"
+    summary = state.summarize_tasks()
+    bump_row = next(a for a in summary["summary"]
+                    if a["name"] == "bump")
+    assert bump_row["by_state"].get("FINISHED", 0) + dropped >= N_TASKS
+
 
 def test_many_placement_groups(scale_cluster):
     from ray_tpu.util.placement_group import (
