@@ -1126,6 +1126,48 @@ def _serve_ha_bench_main():
     print(json.dumps({"metric": "serve_ha", **out}), flush=True)
 
 
+def _gameday_bench_main():
+    """Game-day SLO bench (_BENCH_GAMEDAY=1): run the builtin scenarios
+    end to end — open-loop load with diurnal/flash-crowd shapes + the
+    seeded chaos schedule + rolling updates — and report CLIENT-side
+    p99/p99.9, error-budget burn, failed-request count, and whether the
+    ledger reconciled exactly with the server-side records
+    (docs/GAMEDAY.md). CPU-only; one JSON line.
+
+    Env: BENCH_GAMEDAY_SCENARIOS (default "flagship,flash-crowd"),
+    BENCH_GAMEDAY_SCALE (phase-duration multiplier, default 1.0)."""
+    _force_cpu_platform()
+    from ray_tpu.gameday import load_scenario, run_scenario
+
+    names = [n.strip() for n in os.environ.get(
+        "BENCH_GAMEDAY_SCENARIOS", "flagship,flash-crowd").split(",")
+        if n.strip()]
+    scale = float(os.environ.get("BENCH_GAMEDAY_SCALE", 1.0))
+    out = {"scale": scale, "scenarios": {}}
+    for name in names:
+        sc = load_scenario(name)
+        result = run_scenario(sc, scale=scale, dashboard_port=18471)
+        rep = result.report
+        o = rep["overall"]
+        recon = rep["reconciliation"]
+        out["scenarios"][name] = {
+            "seed": rep["seed"],
+            "requests": o["total"],
+            "admitted": o["admitted"],
+            "shed": o["shed"],
+            "failed": o["failed"],
+            "p50_ms": o["p50_ms"],
+            "p99_ms": o["p99_ms"],
+            "p999_ms": o["p999_ms"],
+            "availability_burn": rep["slo"]["availability_burn"],
+            "latency_burn": rep["slo"].get("latency_burn"),
+            "reconciled": recon["ok"],
+            "chaos_fired": len(rep.get("chaos_fired") or []),
+            "passed": rep["passed"],
+        }
+    print(json.dumps({"metric": "gameday", **out}), flush=True)
+
+
 # ----------------------------------------------------------------- supervise
 
 def _attempt(force_cpu: bool):
@@ -1233,6 +1275,12 @@ def main():
     elif os.environ.get("_BENCH_STATE"):
         try:
             _state_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_GAMEDAY"):
+        try:
+            _gameday_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -2042,6 +2042,19 @@ class Worker:
         if nxt is not None and not nxt.done():
             nxt.set_result(None)
 
+    def _actor_task_events_on(self) -> bool:
+        """RTPU_ACTOR_TASK_EVENTS=1 extends the task-event pipeline to
+        actor method calls (the direct-call fast lane skips the normal
+        execute path). Off by default: steady-state actor chatter
+        (health probes, long-polls) would crowd the bounded task table;
+        the game-day harness turns it on for its cluster so the state
+        engine can be reconciled per request against client ledgers."""
+        on = getattr(self, "_actor_tev_on", None)
+        if on is None:
+            on = bool(os.environ.get("RTPU_ACTOR_TASK_EVENTS"))
+            self._actor_tev_on = on
+        return on
+
     async def _h_actor_call(self, payload, conn):
         loop = asyncio.get_running_loop()
         method_name = payload["method"]
@@ -2061,8 +2074,14 @@ class Worker:
                 "not an actor worker" if inst is None else
                 f"{type(inst).__name__} has no method {method_name}")
 
+        emit_tev = self._actor_task_events_on()
+        fn_label = f"{type(inst).__name__}.{method_name}"
+
         def _run():
             seq = TaskID(bytes.fromhex(payload["task_id"]))
+            if emit_tev:
+                tev.emit(payload["task_id"], tev.RUNNING, name=fn_label,
+                         node_id=self.node_id, worker_pid=os.getpid())
             try:
                 args, kwargs = serialization.deserialize(payload["args"])
                 args = [self._resolve_arg(a) for a in args]
@@ -2072,8 +2091,17 @@ class Worker:
                     result = asyncio.run(result)
                 ser = serialization.serialize(result)
                 oid = ObjectID.for_return(seq, 0)
+                if emit_tev:
+                    tev.emit(payload["task_id"], tev.FINISHED,
+                             name=fn_label, node_id=self.node_id,
+                             worker_pid=os.getpid())
                 return self._ship_return(oid, ser)
             except BaseException as e:  # noqa: BLE001
+                if emit_tev:
+                    tev.emit(payload["task_id"], tev.FAILED,
+                             name=fn_label, node_id=self.node_id,
+                             worker_pid=os.getpid(),
+                             error=f"{type(e).__name__}: {e}"[:200])
                 err = exc.ActorError.capture(
                     f"{type(inst).__name__}.{method_name}", e)
                 ser = serialization.serialize_error(err)

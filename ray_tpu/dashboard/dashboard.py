@@ -12,6 +12,9 @@ registry) and modules/{node,actor,job,metrics,healthz}. Endpoints:
   GET  /api/summary/tasks    per-function task aggregation
   GET  /api/timeline         merged chrome-trace task timeline
   GET  /api/serve/metrics    live serve panel (queue/shed/p99)
+  GET  /api/gameday          last game-day SLO report (client-side
+                             p50/p99/p99.9, ledger counts, budget
+                             burn, reconciliation verdict)
   GET  /api/jobs/            job list      POST /api/jobs/  submit
   GET  /api/jobs/<id>        job info      GET /api/jobs/<id>/logs
   POST /api/jobs/<id>/stop
@@ -115,6 +118,10 @@ class DashboardActor:
                         text += _serve_gauges()
                     except Exception:
                         pass
+                    try:
+                        text += _slo_gauges()
+                    except Exception:
+                        pass
                     return self._text(200, text)
                 if path == "/api/cluster_status":
                     return self._json(200, state.summarize_cluster())
@@ -163,6 +170,10 @@ class DashboardActor:
                     from ray_tpu import serve as _serve
                     return self._json(200,
                                       {"deployments": _serve.metrics()})
+                if path == "/api/gameday":
+                    from ray_tpu.gameday import store as _gd_store
+                    return self._json(200,
+                                      {"report": _gd_store.load_report()})
                 if path == "/api/placement_groups":
                     return self._json(
                         200, {"placement_groups":
@@ -376,6 +387,59 @@ def _serve_gauges() -> str:
         g("ewma_seconds", dep, m.get("ewma_s") or 0,
           "EWMA service time (slowest replica)")
     return "\n" + "\n".join(lines) + "\n" if lines else ""
+
+
+def _slo_gauges() -> str:
+    """Client-side SLO gauges from the last published game-day report
+    (``@gameday/report`` in the GCS KV) — the only exported metrics
+    measured from the LOAD GENERATOR's side of the wire, labeled by
+    scenario + phase. Empty when no game day has run."""
+    from ray_tpu.gameday import store as gd_store
+    report = gd_store.load_report()
+    if not report:
+        return ""
+    scen = report.get("scenario", "unknown")
+    lines = []
+    seen_help = set()
+
+    def g(name, labels, value, help_):
+        full = f"ray_tpu_slo_{name}"
+        if full not in seen_help:
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+            seen_help.add(full)
+        lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        lines.append(f"{full}{{{lbl}}} {float(value)}")
+
+    phases = dict(report.get("phases") or {})
+    phases["_overall"] = report.get("overall") or {}
+    for phase, st in sorted(phases.items()):
+        base = {"scenario": scen, "phase": phase}
+        for outcome in ("admitted", "shed", "failed"):
+            g("requests", {**base, "outcome": outcome},
+              st.get(outcome) or 0,
+              "client-observed request count by outcome")
+        for q in ("p50", "p99", "p999"):
+            g(f"latency_{q}_seconds", base,
+              (st.get(f"{q}_ms") or 0.0) / 1e3,
+              f"client-observed open-loop latency {q}")
+    slo = report.get("slo") or {}
+    g("error_budget_burn", {"scenario": scen, "slo": "availability"},
+      slo.get("availability_burn") or 0.0,
+      "error budget spent (1.0 = exhausted; -1 = zero-budget SLO)")
+    if "latency_burn" in slo:
+        g("error_budget_burn", {"scenario": scen, "slo": "latency"},
+          slo.get("latency_burn") or 0.0,
+          "error budget spent (1.0 = exhausted; -1 = zero-budget SLO)")
+    recon = report.get("reconciliation") or {}
+    g("reconcile_ok", {"scenario": scen},
+      1.0 if recon.get("ok") else 0.0,
+      "1 when the client ledger reconciled exactly with the "
+      "server-side records")
+    g("passed", {"scenario": scen},
+      1.0 if report.get("passed") else 0.0,
+      "1 when the scenario met its SLO and reconciled")
+    return "\n" + "\n".join(lines) + "\n"
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> int:

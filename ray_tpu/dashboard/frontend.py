@@ -65,6 +65,10 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Cluster</h2><div class="tiles" id="tiles"></div></section>
   <section><h2>Serve</h2><table id="serve"></table>
     <div class="muted" id="serve-empty"></div></section>
+  <section><h2>Game day</h2>
+    <div class="tiles" id="gd-tiles"></div>
+    <table id="gameday"></table>
+    <div class="muted" id="gd-empty"></div></section>
   <section><h2>Nodes</h2><table id="nodes"></table></section>
   <section>
     <h2>Tasks</h2>
@@ -134,6 +138,38 @@ async function refresh() {
         (m.replicas ?? 0) + "/" + (m.target_replicas ?? 0),
         m.queue_len ?? 0, m.shed_total ?? 0, m.shed_rate_per_s ?? 0,
         m.requests_total ?? 0, ms(m.p99_s), ms(m.ewma_s)])).join("");
+
+    // last published game-day report: client-side SLO truth (open-loop
+    // p50/p99/p99.9 per phase, ledger counts, budget burn) + the
+    // reconciliation verdict against the server-side records
+    const gd = (await get("/api/gameday")).report;
+    document.getElementById("gd-empty").textContent =
+      gd ? "" : "(no game day has run — ray-tpu gameday run <scenario>)";
+    if (gd) {
+      const recon = gd.reconciliation || {};
+      const slo = gd.slo || {};
+      const o = gd.overall || {};
+      document.getElementById("gd-tiles").innerHTML = [
+        ["scenario", gd.scenario + " @ seed " + gd.seed],
+        ["verdict", gd.passed ? "PASSED" : "FAILED"],
+        ["reconciled", recon.ok ? "yes" : "NO"],
+        ["failed requests", o.failed ?? "-"],
+        ["shed", o.shed ?? "-"],
+        ["budget burn", (slo.availability_burn ?? 0).toFixed(3)],
+      ].map(([k, v]) => `<div class="tile"><b>${esc(v)}</b>${esc(k)}
+        </div>`).join("");
+      const phases = Object.entries(gd.phases || {});
+      document.getElementById("gameday").innerHTML = !phases.length ? "" :
+        head(["phase", "total", "admitted", "shed", "failed", "p50",
+              "p99", "p99.9", "max"]) +
+        phases.map(([n, p]) => row([n, p.total, p.admitted, p.shed,
+          {v: p.failed, cls: p.failed ? "st-FAILED" : ""},
+          p.p50_ms + "ms", p.p99_ms + "ms", p.p999_ms + "ms",
+          p.max_ms + "ms"])).join("");
+    } else {
+      document.getElementById("gd-tiles").innerHTML = "";
+      document.getElementById("gameday").innerHTML = "";
+    }
 
     const nodes = (await get("/api/nodes")).nodes || [];
     const stats = (await get("/api/nodes/stats")).nodes || [];

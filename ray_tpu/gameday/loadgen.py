@@ -1,0 +1,252 @@
+"""Deterministic open-loop load generation.
+
+Two halves, split on purpose:
+
+* ``build_schedule`` turns a phase spec + seed into a concrete list of
+  ``Arrival``s ahead of time — a pure function, so the same (spec,
+  seed) always produces byte-identical arrivals (request ids included)
+  and a scenario can be replayed or diffed without running anything.
+
+* ``OpenLoopRunner`` fires those arrivals at their *scheduled* instants
+  regardless of how the system under test is doing, and measures each
+  request's latency from its scheduled arrival — not from the moment a
+  worker got around to sending it. That is the open-loop discipline:
+  a closed-loop client that stalls behind a slow server silently stops
+  generating load and reports healthy latencies for the requests it
+  didn't send (coordinated omission). Here a stall shows up exactly
+  where a real user would feel it — as queueing delay on every arrival
+  scheduled during the stall.
+
+Arrival processes are nonhomogeneous Poisson, sampled by Lewis-Shedler
+thinning against each phase's peak rate, with heavy-tail (bounded
+Pareto) request sizes and Zipf-skewed tenant assignment — all drawn
+from one ``random.Random(seed)`` stream.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+class Arrival:
+    """One scheduled request: fires at ``t`` seconds after load start."""
+
+    __slots__ = ("t", "rid", "phase", "tenant", "size")
+
+    def __init__(self, t: float, rid: str, phase: str, tenant: str,
+                 size: float):
+        self.t = t
+        self.rid = rid
+        self.phase = phase
+        self.tenant = tenant
+        self.size = size  # heavy-tail work multiplier (1.0 = median-ish)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"t": round(self.t, 6), "rid": self.rid,
+                "phase": self.phase, "tenant": self.tenant,
+                "size": round(self.size, 4)}
+
+
+class ArrivalSchedule:
+    def __init__(self, arrivals: List[Arrival],
+                 phases: List[Dict[str, Any]], seed: int):
+        self.arrivals = arrivals
+        self.phases = phases
+        self.seed = seed
+
+    def __len__(self):
+        return len(self.arrivals)
+
+    @property
+    def duration_s(self) -> float:
+        return sum(float(p.get("duration_s", 0.0)) for p in self.phases)
+
+    def rate_in(self, t0: float, t1: float) -> float:
+        n = sum(1 for a in self.arrivals if t0 <= a.t < t1)
+        return n / max(t1 - t0, 1e-9)
+
+
+def _phase_rate(phase: Dict[str, Any], frac: float) -> float:
+    """Instantaneous request rate at fraction ``frac`` of the phase.
+
+    Shapes:
+      steady       rps
+      ramp         linear start_rps -> end_rps
+      diurnal      half sine trough min_rps -> crest peak_rps -> trough
+      flash_crowd  base_rps, with burst_rps inside the window
+                   [burst_start_frac, burst_start_frac + burst_frac)
+    """
+    shape = phase.get("shape", "steady")
+    if shape == "steady":
+        return float(phase.get("rps", 10.0))
+    if shape == "ramp":
+        a = float(phase.get("start_rps", 10.0))
+        b = float(phase.get("end_rps", 10.0))
+        return a + (b - a) * frac
+    if shape == "diurnal":
+        lo = float(phase.get("min_rps", 10.0))
+        hi = float(phase.get("peak_rps", 10.0))
+        return lo + (hi - lo) * math.sin(math.pi * frac)
+    if shape == "flash_crowd":
+        base = float(phase.get("base_rps", 10.0))
+        burst = float(phase.get("burst_rps", base))
+        start = float(phase.get("burst_start_frac", 0.25))
+        width = float(phase.get("burst_frac", 0.5))
+        return burst if start <= frac < start + width else base
+    raise ValueError(f"unknown load shape {shape!r}")
+
+
+def _phase_peak(phase: Dict[str, Any]) -> float:
+    shape = phase.get("shape", "steady")
+    if shape == "steady":
+        return float(phase.get("rps", 10.0))
+    if shape == "ramp":
+        return max(float(phase.get("start_rps", 10.0)),
+                   float(phase.get("end_rps", 10.0)))
+    if shape == "diurnal":
+        return float(phase.get("peak_rps", 10.0))
+    if shape == "flash_crowd":
+        return max(float(phase.get("base_rps", 10.0)),
+                   float(phase.get("burst_rps", 10.0)))
+    raise ValueError(f"unknown load shape {shape!r}")
+
+
+def _tenant_weights(n: int, skew: float) -> List[float]:
+    # Zipf-ish: tenant i carries weight 1/(i+1)^skew; skew 0 = uniform
+    return [1.0 / (i + 1) ** skew for i in range(n)]
+
+
+def build_schedule(phases: Sequence[Dict[str, Any]], seed: int,
+                   *, name: str = "gameday",
+                   tenants: int = 4, tenant_skew: float = 1.2,
+                   size_alpha: float = 1.8,
+                   size_cap: float = 20.0) -> ArrivalSchedule:
+    """Pure (spec, seed) -> arrivals. Request ids are sequential and
+    embed the seed, so a replayed schedule is id-for-id identical and
+    two different seeds can never alias in a shared ledger."""
+    rng = random.Random(f"gameday:{seed}:{name}")
+    weights = _tenant_weights(max(1, tenants), tenant_skew)
+    tenant_names = [f"tenant-{i}" for i in range(max(1, tenants))]
+    arrivals: List[Arrival] = []
+    t_base = 0.0
+    i = 0
+    for phase in phases:
+        dur = float(phase.get("duration_s", 0.0))
+        if dur <= 0:
+            continue
+        peak = max(_phase_peak(phase), 1e-9)
+        t = 0.0
+        while True:
+            # Lewis-Shedler thinning: candidate gaps at the peak rate,
+            # accepted with probability rate(t)/peak — exact for a
+            # nonhomogeneous Poisson process, and the draw count per
+            # phase is a function of the seed alone
+            t += rng.expovariate(peak)
+            if t >= dur:
+                break
+            if rng.random() * peak > _phase_rate(phase, t / dur):
+                continue
+            # bounded Pareto sizes: median ~1, tail up to size_cap
+            size = min(rng.paretovariate(size_alpha), size_cap)
+            tenant = rng.choices(tenant_names, weights=weights)[0]
+            arrivals.append(Arrival(
+                t_base + t, f"{name}-{seed}-{i:06d}",
+                phase.get("name", "phase"), tenant, size))
+            i += 1
+        t_base += dur
+    return ArrivalSchedule(arrivals, list(phases), seed)
+
+
+class RequestRecord:
+    """Client-side truth for one request. ``latency_s`` runs from the
+    SCHEDULED arrival to completion (open-loop; includes any dispatch
+    or queueing delay); ``service_s`` from actual send to completion
+    (diagnostic only)."""
+
+    __slots__ = ("rid", "phase", "tenant", "size", "sched_t", "start_t",
+                 "end_t", "outcome", "error")
+
+    def __init__(self, arrival: Arrival, sched_t: float, start_t: float,
+                 end_t: float, outcome: str, error: Optional[str]):
+        self.rid = arrival.rid
+        self.phase = arrival.phase
+        self.tenant = arrival.tenant
+        self.size = arrival.size
+        self.sched_t = sched_t
+        self.start_t = start_t
+        self.end_t = end_t
+        self.outcome = outcome  # "ok" | "shed" | "failed"
+        self.error = error
+
+    @property
+    def latency_s(self) -> float:
+        return max(0.0, self.end_t - self.sched_t)
+
+    @property
+    def service_s(self) -> float:
+        return max(0.0, self.end_t - self.start_t)
+
+
+class OpenLoopRunner:
+    """Fires a precomputed schedule open-loop.
+
+    ``send`` is called with each ``Arrival`` and either returns (ok) or
+    raises; ``classify`` maps the exception to ``"shed"`` or
+    ``"failed"``. A bounded worker pool executes sends; if every worker
+    is busy when an arrival is due it is dispatched late and the
+    lateness is charged to that request's latency — never silently
+    skipped (that would be coordinated omission by another name).
+    """
+
+    def __init__(self, schedule: ArrivalSchedule,
+                 send: Callable[[Arrival], Any],
+                 classify: Optional[Callable[[BaseException], str]] = None,
+                 max_workers: int = 32):
+        self._schedule = schedule
+        self._send = send
+        self._classify = classify or (lambda e: "failed")
+        self._max_workers = max(1, int(max_workers))
+        self._lock = threading.Lock()
+        self.records: List[RequestRecord] = []
+        self.started_at: Optional[float] = None
+
+    def _fire(self, arrival: Arrival, sched_abs: float):
+        start = time.time()
+        outcome, err = "ok", None
+        try:
+            self._send(arrival)
+        except BaseException as e:  # noqa: BLE001 — every failure counts
+            outcome = self._classify(e)
+            if outcome not in ("shed", "failed"):
+                outcome = "failed"
+            err = f"{type(e).__name__}: {e}".split("\n")[0][:200]
+        rec = RequestRecord(arrival, sched_abs, start, time.time(),
+                            outcome, err)
+        with self._lock:
+            self.records.append(rec)
+
+    def run(self, on_phase: Optional[Callable[[str], None]] = None
+            ) -> List[RequestRecord]:
+        """Blocks until every scheduled request has completed."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        t0 = time.time()
+        self.started_at = t0
+        cur_phase = None
+        with ThreadPoolExecutor(max_workers=self._max_workers,
+                                thread_name_prefix="gameday") as pool:
+            for arrival in self._schedule.arrivals:
+                sched_abs = t0 + arrival.t
+                delay = sched_abs - time.time()
+                if delay > 0:
+                    time.sleep(delay)
+                if on_phase is not None and arrival.phase != cur_phase:
+                    cur_phase = arrival.phase
+                    on_phase(cur_phase)
+                pool.submit(self._fire, arrival, sched_abs)
+        with self._lock:
+            return list(self.records)

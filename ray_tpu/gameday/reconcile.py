@@ -1,0 +1,256 @@
+"""Outside-in reconciliation: the client ledger vs the server's story.
+
+The game-day verdict is only trustworthy if the observability plane
+agrees with it — and the observability plane is only trustworthy if it
+agrees with the clients. This pass joins the two views per request id
+(the id the client generated, propagated proxy→router→replica and
+recorded in each replica's request ledger) and cross-checks every
+aggregate the server publishes:
+
+  C1 completed-join   every client-observed success has a matching
+                      "ok" record in some replica ledger (live or
+                      flushed-on-drain)
+  C2 admitted=completed  the match is exact: no success double-served,
+                      no server completion for a request the client
+                      saw fail (an "unexplained outcome")
+  C3 shed-listed      every client-observed shed appears as a shed
+                      record server-side
+  C4 replica-totals   each live replica's counters equal its own
+                      ledger (the counters feeding routing/autoscaling
+                      can't drift from the per-request truth)
+  C5 serve-metrics    the controller's aggregated serve metrics equal
+                      the sum of live replica counters
+  C6 state-engine     the GCS task table's FINISHED/FAILED deltas for
+                      the replica request method equal the client's
+                      ok/shed+failed counts
+  C7 prometheus       the scraped ``ray_tpu_serve_*`` gauges equal the
+                      controller metrics they claim to export
+  C8 chaos-replay     the faults that actually fired are exactly the
+                      scenario's seeded schedule (site/op/hit-count)
+
+Any disagreement fails the check (and, in tier-1, the test) — except
+where the scenario explicitly tolerates records lost with SIGKILLed
+replicas (``tolerate_lost_server_records``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set
+
+
+def _check(name: str, ok: bool, detail: str) -> Dict[str, Any]:
+    return {"name": name, "ok": bool(ok), "detail": detail}
+
+
+def _ledger_rids(ledgers: List[Dict[str, Any]], outcome: str) -> List[str]:
+    out = []
+    for led in ledgers:
+        for rec in led.get("records") or []:
+            rid, out_come = rec[0], rec[1]
+            if rid is not None and out_come == outcome:
+                out.append(rid)
+    return out
+
+
+def reconcile(scenario, client_ledger: Dict[str, List[str]],
+              server_view: Dict[str, Any]) -> Dict[str, Any]:
+    """client ledger ({"ok"/"shed"/"failed": [rids]}) + collected
+    server view -> reconciliation report. ``server_view`` keys (all
+    optional — absent sources are reported as skipped, not passed):
+
+      replica_ledgers   [{deployment, replica, live, records}]
+      replica_metrics   {replica_name: get_metrics() dict}
+      serve_metrics     serve.metrics() snapshot (quiesced)
+      task_delta        {finished, failed, dropped, events_dropped}
+      prometheus        {serve: {deployment: {metric: value}}}
+      chaos_fired       chaos.read_log records
+      chaos_expected    the scenario's chaos_config schedule (or None)
+    """
+    checks: List[Dict[str, Any]] = []
+    tolerate = bool(getattr(scenario, "tolerate_lost_server_records",
+                            False))
+
+    ok_rids = set(client_ledger.get("ok") or [])
+    shed_rids = set(client_ledger.get("shed") or [])
+    failed_rids = set(client_ledger.get("failed") or [])
+    # admission sheds: the router couldn't place them (every replica
+    # saturated) — they never reached a replica, so the server must
+    # have NO record of them
+    unplaced_rids = set(client_ledger.get("unplaced") or [])
+
+    ledgers = server_view.get("replica_ledgers") or []
+    server_ok_list = _ledger_rids(ledgers, "ok")
+    server_ok: Set[str] = set(server_ok_list)
+    server_shed: Set[str] = set(_ledger_rids(ledgers, "shed"))
+
+    # C1: every client success is known to a replica ledger
+    missing = ok_rids - server_ok
+    if tolerate and missing:
+        checks.append(_check(
+            "completed-join", True,
+            f"{len(missing)} client-ok records lost with SIGKILLed "
+            f"replicas (tolerated by scenario)"))
+    else:
+        checks.append(_check(
+            "completed-join", not missing,
+            f"{len(ok_rids)} client-ok, {len(missing)} missing from "
+            f"replica ledgers" + (f" e.g. {sorted(missing)[:3]}"
+                                  if missing else "")))
+
+    # C2: exact — no double completion, no unexplained outcome, and
+    # nothing the router never placed shows up server-side
+    dupes = len(server_ok_list) - len(server_ok)
+    unexplained = server_ok & (shed_rids | failed_rids)
+    ghost = unplaced_rids & (server_ok | server_shed)
+    checks.append(_check(
+        "admitted-equals-completed",
+        dupes == 0 and not unexplained and not ghost,
+        f"{dupes} duplicate completions, {len(unexplained)} requests "
+        f"completed server-side but shed/failed client-side, "
+        f"{len(ghost)} never-placed requests with server records"))
+
+    # C3: sheds the client saw are listed as sheds server-side
+    unlisted = shed_rids - server_shed
+    if tolerate and unlisted:
+        checks.append(_check(
+            "shed-listed", True,
+            f"{len(unlisted)} shed records lost with SIGKILLed "
+            f"replicas (tolerated)"))
+    else:
+        checks.append(_check(
+            "shed-listed", not unlisted,
+            f"{len(shed_rids)} client-shed, {len(unlisted)} not "
+            f"listed as shed server-side"))
+
+    # C4: each live replica's counters == its own ledger
+    rep_metrics = server_view.get("replica_metrics") or {}
+    by_name = {led.get("replica"): led for led in ledgers
+               if led.get("live")}
+    bad = []
+    for name, m in rep_metrics.items():
+        led = by_name.get(name)
+        if led is None:
+            continue
+        recs = led.get("records") or []
+        if led.get("truncated"):
+            bad.append(f"{name}: ledger truncated (raise "
+                       f"RTPU_SERVE_REQUEST_LOG_MAX)")
+            continue
+        admitted = sum(1 for r in recs if r[1] in ("ok", "error"))
+        shed = sum(1 for r in recs if r[1] == "shed")
+        if admitted != m.get("total_requests") or \
+                shed != m.get("total_shed"):
+            bad.append(f"{name}: ledger {admitted} adm/{shed} shed vs "
+                       f"counters {m.get('total_requests')}/"
+                       f"{m.get('total_shed')}")
+    checks.append(_check("replica-totals", not bad,
+                         "; ".join(bad) if bad
+                         else f"{len(rep_metrics)} live replicas agree"))
+
+    # C5: controller aggregation == sum of live replica counters
+    serve_metrics = server_view.get("serve_metrics") or {}
+    if serve_metrics and rep_metrics:
+        sum_req = sum(m.get("total_requests", 0)
+                      for m in rep_metrics.values())
+        sum_shed = sum(m.get("total_shed", 0)
+                       for m in rep_metrics.values())
+        agg_req = sum(d.get("requests_total", 0)
+                      for d in serve_metrics.values())
+        agg_shed = sum(d.get("shed_total", 0)
+                       for d in serve_metrics.values())
+        checks.append(_check(
+            "serve-metrics-agree",
+            agg_req == sum_req and agg_shed == sum_shed,
+            f"controller {agg_req} req/{agg_shed} shed vs replicas "
+            f"{sum_req}/{sum_shed}"))
+    else:
+        checks.append(_check("serve-metrics-agree", True,
+                             "skipped (no serve metrics collected)"))
+
+    # C6: the state engine's task table tells the same story
+    delta = server_view.get("task_delta")
+    if delta is not None:
+        lossy = (delta.get("dropped", 0) > 0
+                 or delta.get("events_dropped", 0) > 0)
+        want_fin = len(ok_rids)
+        want_fail = len(shed_rids) + len(failed_rids)
+        got_fin = delta.get("finished", -1)
+        got_fail = delta.get("failed", -1)
+        if lossy:
+            checks.append(_check(
+                "state-engine-tasks", True,
+                f"skipped exact match: task table lossy "
+                f"(dropped={delta.get('dropped')}, events_dropped="
+                f"{delta.get('events_dropped')})"))
+        elif tolerate:
+            # SIGKILLed replicas both lose buffered events and can
+            # leave an extra FINISHED behind a lost reply that the
+            # client retried — exactness is only meaningful for
+            # graceful scenarios, so report, don't grade
+            checks.append(_check(
+                "state-engine-tasks", True,
+                f"informational (lost-record tolerance): FINISHED "
+                f"{got_fin} vs client-ok {want_fin}, FAILED {got_fail} "
+                f"vs client shed+failed {want_fail}"))
+        else:
+            checks.append(_check(
+                "state-engine-tasks",
+                got_fin == want_fin and got_fail == want_fail,
+                f"FINISHED {got_fin} vs client-ok {want_fin}; FAILED "
+                f"{got_fail} vs client shed+failed {want_fail}"))
+    else:
+        checks.append(_check("state-engine-tasks", True,
+                             "skipped (no task delta collected)"))
+
+    # C7: Prometheus exposition == the controller metrics it exports
+    prom = (server_view.get("prometheus") or {}).get("serve")
+    if prom is not None and serve_metrics:
+        bad = []
+        for dep, m in serve_metrics.items():
+            g = prom.get(dep) or {}
+            for prom_key, serve_key in (("requests_total",
+                                         "requests_total"),
+                                        ("shed_total", "shed_total")):
+                if g.get(prom_key) is None or \
+                        int(g[prom_key]) != int(m.get(serve_key, -1)):
+                    bad.append(f"{dep}.{prom_key}: scraped "
+                               f"{g.get(prom_key)} vs controller "
+                               f"{m.get(serve_key)}")
+        checks.append(_check("prometheus-serve-gauges", not bad,
+                             "; ".join(bad) if bad
+                             else f"{len(serve_metrics)} deployments "
+                                  f"agree with /metrics"))
+    else:
+        checks.append(_check("prometheus-serve-gauges", True,
+                             "skipped (no /metrics scrape)"))
+
+    # C8: the faults that fired are the scenario's seeded schedule
+    expected = server_view.get("chaos_expected")
+    fired = server_view.get("chaos_fired")
+    if expected:
+        want = sorted((e["site"], e["op"], int(e.get("at", 1)))
+                      for e in expected.get("schedule") or [])
+        got = sorted((r.get("site"), r.get("op"), int(r.get("n", -1)))
+                     for r in fired or [])
+        checks.append(_check(
+            "chaos-schedule-replay", want == got,
+            f"expected {want} fired {got}"))
+    elif fired:
+        checks.append(_check("chaos-schedule-replay", False,
+                             f"faults fired with no schedule: {fired}"))
+    else:
+        checks.append(_check("chaos-schedule-replay", True,
+                             "no faults scheduled, none fired"))
+
+    return {
+        "ok": all(c["ok"] for c in checks),
+        "checks": checks,
+        "counts": {
+            "client_ok": len(ok_rids),
+            "client_shed": len(shed_rids),
+            "client_unplaced": len(unplaced_rids),
+            "client_failed": len(failed_rids),
+            "server_completed": len(server_ok),
+            "server_shed_listed": len(server_shed),
+        },
+    }

@@ -1,0 +1,290 @@
+"""Replayable game-day scenario specs.
+
+A scenario composes three schedules that previously only existed in
+separate test suites, under ONE seed:
+
+* the open-loop load phases (``loadgen.build_schedule``),
+* timed control-plane actions the runner executes (rolling update,
+  explicit scale changes),
+* the chaos engine's fault schedule (controller / replica SIGKILLs at
+  exact hit counts — PR 4 semantics: the N-th control-loop tick, the
+  N-th accepted request).
+
+Everything derives deterministically from the spec: ``chaos_config``
+and ``build_schedule`` are pure functions, so replaying a scenario
+with the same seed reproduces the same arrivals (ids included) and the
+same fault schedule — the property the flagship tier-1 gate asserts.
+
+Scenarios are plain dict-shaped and JSON-loadable (``load_scenario``
+accepts a builtin name or a ``.json`` path), so a new workload ships
+its game day as a spec file, not a bespoke test harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.gameday.loadgen import ArrivalSchedule, build_schedule
+
+DEPLOYMENT_NAME = "GameDay"
+
+
+class Scenario:
+    """One game day: load shapes + timed actions + fault schedule +
+    the SLO it is graded against."""
+
+    def __init__(self, name: str, *, seed: int,
+                 phases: List[Dict[str, Any]],
+                 actions: Optional[List[Dict[str, Any]]] = None,
+                 deployment: Optional[Dict[str, Any]] = None,
+                 slo: Optional[Dict[str, Any]] = None,
+                 tenants: int = 4, tenant_skew: float = 1.2,
+                 max_workers: int = 32,
+                 tolerate_lost_server_records: bool = False,
+                 description: str = ""):
+        self.name = name
+        self.seed = int(seed)
+        self.phases = phases
+        self.actions = actions or []
+        self.deployment = {
+            "num_replicas": 3,
+            "max_concurrent_queries": 16,
+            "max_queued_requests": 64,
+            "service_time_ms": 3.0,
+            "graceful_shutdown_timeout_s": 10.0,
+            # router admission bound: an arrival not placeable within
+            # this window is shed client-side (the proxy's 503)
+            "assign_timeout_s": 30.0,
+            **(deployment or {}),
+        }
+        self.slo = {
+            "availability_target": 0.999,
+            "latency_target_ms": None,
+            "count_shed_as_bad": False,
+            **(slo or {}),
+        }
+        self.tenants = tenants
+        self.tenant_skew = tenant_skew
+        self.max_workers = max_workers
+        # scenarios that SIGKILL replicas lose those replicas' ledgers;
+        # the reconciler then tolerates client-ok requests whose server
+        # record died with the replica (counted, reported, not failed)
+        self.tolerate_lost_server_records = tolerate_lost_server_records
+        self.description = description
+
+    # ---- derived, deterministic schedules ----
+
+    def arrival_schedule(self, scale: float = 1.0) -> ArrivalSchedule:
+        """``scale`` stretches phase durations (0.5 = half-length game
+        day) without touching rates, ids or the seed."""
+        phases = [dict(p, duration_s=float(p.get("duration_s", 0.0))
+                       * scale) for p in self.phases]
+        return build_schedule(phases, self.seed, name=self.name,
+                              tenants=self.tenants,
+                              tenant_skew=self.tenant_skew)
+
+    def timed_actions(self, scale: float = 1.0) -> List[Dict[str, Any]]:
+        """Runner-executed actions, time-scaled like the load."""
+        out = []
+        for a in self.actions:
+            if a["kind"] in ("rolling_update", "scale"):
+                out.append(dict(a, t_s=float(a.get("t_s", 0.0)) * scale))
+        return sorted(out, key=lambda a: a["t_s"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "seed": self.seed,
+            "description": self.description,
+            "phases": self.phases, "actions": self.actions,
+            "deployment": self.deployment, "slo": self.slo,
+            "tenants": self.tenants, "tenant_skew": self.tenant_skew,
+            "max_workers": self.max_workers,
+            "tolerate_lost_server_records":
+                self.tolerate_lost_server_records,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Scenario":
+        d = dict(d)
+        name = d.pop("name")
+        seed = d.pop("seed", 0)
+        phases = d.pop("phases")
+        return cls(name, seed=seed, phases=phases, **d)
+
+
+def chaos_config(scenario: Scenario) -> Optional[Dict[str, Any]]:
+    """Scenario -> the ``RTPU_CHAOS`` config dict (or None when the
+    scenario injects no faults). Pure: same scenario+seed, same
+    schedule — fault positions are HIT COUNTS (the chaos engine's
+    replayable unit), not wall-clock times."""
+    schedule: List[Dict[str, Any]] = []
+    for a in scenario.actions:
+        if a["kind"] == "controller_kill":
+            schedule.append({"site": "serve.controller.tick",
+                             "op": "kill", "at": int(a.get("tick", 5)),
+                             "proc": "worker"})
+        elif a["kind"] == "replica_kill":
+            schedule.append({"site": "serve.replica.request",
+                             "op": "kill",
+                             "at": int(a.get("request", 50)),
+                             "method": DEPLOYMENT_NAME,
+                             "proc": "worker"})
+    if not schedule:
+        return None
+    return {"seed": scenario.seed, "schedule": schedule}
+
+
+# ---------------------------------------------------------------- builtins
+
+
+def _flagship(seed: int = 411) -> Scenario:
+    """The standing acceptance scenario (ROADMAP item 8): peak
+    open-loop load with a diurnal ramp into a flash crowd, a rolling
+    update launched mid-peak, and a chaos-seeded controller SIGKILL —
+    gated on ZERO client-observed failed requests and an exact
+    client/server reconciliation."""
+    return Scenario(
+        "flagship", seed=seed,
+        description="rolling update + controller kill at peak load; "
+                    "gate: 0 failed requests, exact reconciliation",
+        phases=[
+            {"name": "warmup", "duration_s": 2.0, "shape": "steady",
+             "rps": 20},
+            {"name": "ramp", "duration_s": 3.0, "shape": "diurnal",
+             "min_rps": 20, "peak_rps": 70},
+            {"name": "peak", "duration_s": 6.0, "shape": "flash_crowd",
+             "base_rps": 50, "burst_rps": 90,
+             "burst_start_frac": 0.2, "burst_frac": 0.5},
+            {"name": "cooldown", "duration_s": 2.0, "shape": "steady",
+             "rps": 15},
+        ],
+        actions=[
+            # mid-peak redeploy: start-before-stop waves must absorb it
+            {"kind": "rolling_update", "t_s": 6.0},
+            # the controller dies at its 6th control-loop tick (~6 s
+            # after serve.start) — recovery rides the journal while the
+            # data plane serves from cached route tables
+            {"kind": "controller_kill", "tick": 6},
+        ],
+        deployment={"num_replicas": 3, "max_concurrent_queries": 16,
+                    "max_queued_requests": 96, "service_time_ms": 3.0},
+        slo={"availability_target": 0.999, "latency_target_ms": 250.0},
+    )
+
+
+def _flash_crowd(seed: int = 902) -> Scenario:
+    """Pure capacity story: a 4x flash crowd against a deployment
+    sized for the baseline — the burst exceeds capacity, so admission
+    control MUST shed (router assign timeout = the proxy's retriable
+    503), sheds are counted and reconciled, and nothing may fail.
+    Offered burst load ≈ 160 rps x ~95 ms mean service ≈ 15 concurrent
+    vs 2 replicas x 4 slots = 8 — saturation by construction."""
+    return Scenario(
+        "flash-crowd", seed=seed,
+        description="4x burst past capacity; sheds expected and "
+                    "reconciled, 0 failed",
+        phases=[
+            {"name": "warmup", "duration_s": 2.0, "shape": "steady",
+             "rps": 15},
+            {"name": "crowd", "duration_s": 6.0, "shape": "flash_crowd",
+             "base_rps": 30, "burst_rps": 160,
+             "burst_start_frac": 0.3, "burst_frac": 0.4},
+            {"name": "cooldown", "duration_s": 2.5, "shape": "steady",
+             "rps": 10},
+        ],
+        actions=[],
+        deployment={"num_replicas": 2, "max_concurrent_queries": 4,
+                    "max_queued_requests": 8, "service_time_ms": 50.0,
+                    "assign_timeout_s": 0.75},
+        slo={"availability_target": 0.999, "latency_target_ms": 2000.0,
+             "count_shed_as_bad": False},
+        max_workers=48,
+    )
+
+
+def _replica_storm(seed: int = 737) -> Scenario:
+    """Chaos-heavy variant: a replica SIGKILLed at an exact accepted-
+    request count while traffic runs. Handle callers see the blast
+    radius (requests in flight on the dead replica), so the SLO allows
+    a small failure budget and the reconciler tolerates ledger records
+    lost with the killed replica."""
+    return Scenario(
+        "replica-storm", seed=seed,
+        description="replica SIGKILL under load; bounded blast radius",
+        phases=[
+            {"name": "warmup", "duration_s": 2.0, "shape": "steady",
+             "rps": 20},
+            {"name": "storm", "duration_s": 6.0, "shape": "steady",
+             "rps": 80},
+            {"name": "cooldown", "duration_s": 2.0, "shape": "steady",
+             "rps": 15},
+        ],
+        # each replica dies at ITS 100th accepted request (the chaos
+        # engine is per-process) — originals absorb ~160 requests each
+        # over the storm, so the kills stagger through it while the
+        # replacements stay under the threshold
+        actions=[{"kind": "replica_kill", "request": 100}],
+        deployment={"num_replicas": 3, "max_concurrent_queries": 16,
+                    "max_queued_requests": 64, "service_time_ms": 3.0},
+        slo={"availability_target": 0.98, "latency_target_ms": 500.0},
+        tolerate_lost_server_records=True,
+    )
+
+
+def _diurnal_soak(seed: int = 128) -> Scenario:
+    """Long soak (marked ``slow`` in tests): three diurnal cycles with
+    a rolling update per trough and a controller kill mid-cycle."""
+    cycles = []
+    for i in range(3):
+        cycles.append({"name": f"day{i}", "duration_s": 20.0,
+                       "shape": "diurnal", "min_rps": 10,
+                       "peak_rps": 60})
+    return Scenario(
+        "diurnal-soak", seed=seed,
+        description="3 diurnal cycles, rolling update per trough, one "
+                    "controller kill",
+        phases=cycles,
+        actions=[
+            {"kind": "rolling_update", "t_s": 19.0},
+            {"kind": "rolling_update", "t_s": 39.0},
+            {"kind": "controller_kill", "tick": 30},
+        ],
+        deployment={"num_replicas": 3, "max_concurrent_queries": 16,
+                    "max_queued_requests": 96, "service_time_ms": 3.0},
+        slo={"availability_target": 0.999, "latency_target_ms": 250.0},
+    )
+
+
+_BUILTIN = {
+    "flagship": _flagship,
+    "flash-crowd": _flash_crowd,
+    "replica-storm": _replica_storm,
+    "diurnal-soak": _diurnal_soak,
+}
+
+
+def builtin_scenarios() -> Dict[str, str]:
+    """name -> one-line description of every builtin scenario."""
+    return {name: fn().description for name, fn in _BUILTIN.items()}
+
+
+def load_scenario(name_or_path: str,
+                  seed: Optional[int] = None) -> Scenario:
+    """Resolve a builtin scenario name or a JSON spec file; ``seed``
+    overrides the spec's seed (a different seed is a different — but
+    equally replayable — game day)."""
+    if name_or_path in _BUILTIN:
+        sc = (_BUILTIN[name_or_path](seed) if seed is not None
+              else _BUILTIN[name_or_path]())
+        return sc
+    if os.path.exists(name_or_path):
+        with open(name_or_path) as f:
+            sc = Scenario.from_dict(json.load(f))
+        if seed is not None:
+            sc.seed = int(seed)
+        return sc
+    raise ValueError(
+        f"unknown scenario {name_or_path!r}; builtins: "
+        f"{', '.join(sorted(_BUILTIN))} (or a path to a JSON spec)")

@@ -1,0 +1,179 @@
+"""Client-side SLO accounting.
+
+Everything here is computed from ``RequestRecord``s alone — the load
+generator's view, never the server's. The report a game day publishes
+is therefore the number a *user* would have measured, and the server's
+own telemetry has to reconcile against it (``reconcile.py``), not the
+other way around.
+
+Latency quantiles come from a log-bucketed histogram (bounded memory,
+mergeable, ~2.5% bucket resolution) over open-loop latencies — the
+time from each request's *scheduled* arrival to completion, so stalls
+charge every request they delayed.
+
+Error-budget burn follows the SRE definition: with availability target
+``a`` over a window, the budget is the ``1 - a`` fraction of requests
+allowed to fail; burn is the fraction of that budget actually spent,
+normalized so 1.0 = exactly exhausted. A separate latency budget burns
+on requests over ``latency_target_ms``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Optional
+
+_BUCKET_BASE_S = 1e-4      # 0.1 ms floor
+_BUCKET_GROWTH = 1.025     # ~2.5% relative resolution per bucket
+_BUCKET_COUNT = 640        # covers 0.1 ms .. ~700 s
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram: O(1) record, bounded memory,
+    mergeable across phases/clients, quantiles within one bucket."""
+
+    __slots__ = ("counts", "n", "max_s", "sum_s")
+
+    def __init__(self):
+        self.counts = [0] * _BUCKET_COUNT
+        self.n = 0
+        self.max_s = 0.0
+        self.sum_s = 0.0
+
+    def _bucket(self, v: float) -> int:
+        if v <= _BUCKET_BASE_S:
+            return 0
+        b = int(math.log(v / _BUCKET_BASE_S) / math.log(_BUCKET_GROWTH))
+        return min(b, _BUCKET_COUNT - 1)
+
+    def record(self, v: float):
+        self.counts[self._bucket(v)] += 1
+        self.n += 1
+        self.sum_s += v
+        if v > self.max_s:
+            self.max_s = v
+
+    def merge(self, other: "LatencyHistogram"):
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.n += other.n
+        self.sum_s += other.sum_s
+        self.max_s = max(self.max_s, other.max_s)
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-th sample (a latency
+        SLO wants "no worse than", so the conservative edge)."""
+        if self.n == 0:
+            return 0.0
+        rank = min(self.n - 1, int(q * self.n))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen > rank:
+                return min(_BUCKET_BASE_S * _BUCKET_GROWTH ** (i + 1),
+                           self.max_s)
+        return self.max_s
+
+    def mean(self) -> float:
+        return self.sum_s / self.n if self.n else 0.0
+
+
+def error_budget_burn(total: int, bad: int, target: float) -> float:
+    """Fraction of the error budget spent (1.0 = exhausted). A target
+    of 1.0 (zero allowed failures) burns infinitely on the first bad
+    request — reported as ``inf``."""
+    if total <= 0 or bad <= 0:
+        return 0.0
+    allowed = (1.0 - target) * total
+    if allowed <= 0:
+        return float("inf")
+    return bad / allowed
+
+
+def _phase_stats(records, hist: LatencyHistogram) -> Dict[str, Any]:
+    ok = sum(1 for r in records if r.outcome == "ok")
+    shed = sum(1 for r in records if r.outcome == "shed")
+    failed = sum(1 for r in records if r.outcome == "failed")
+    return {
+        "total": len(records),
+        "admitted": ok,
+        "shed": shed,
+        "failed": failed,
+        "p50_ms": round(hist.quantile(0.50) * 1e3, 3),
+        "p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+        "p999_ms": round(hist.quantile(0.999) * 1e3, 3),
+        "max_ms": round(hist.max_s * 1e3, 3),
+        "mean_ms": round(hist.mean() * 1e3, 3),
+    }
+
+
+def build_report(records: Iterable[Any], *,
+                 scenario: str = "gameday", seed: int = 0,
+                 availability_target: float = 0.999,
+                 latency_target_ms: Optional[float] = None,
+                 count_shed_as_bad: bool = False,
+                 duration_s: float = 0.0) -> Dict[str, Any]:
+    """Records -> the client-side SLO report (JSON-serializable).
+
+    ``count_shed_as_bad`` decides whether load shedding (retriable 503)
+    burns availability budget: a capacity game day says no (shedding IS
+    the designed behavior under overload), a strict availability SLO
+    says yes.
+    """
+    records = list(records)
+    by_phase: Dict[str, List[Any]] = {}
+    phase_hists: Dict[str, LatencyHistogram] = {}
+    overall_hist = LatencyHistogram()
+    per_tenant: Dict[str, int] = {}
+    for r in records:
+        by_phase.setdefault(r.phase, []).append(r)
+        h = phase_hists.get(r.phase)
+        if h is None:
+            h = phase_hists[r.phase] = LatencyHistogram()
+        if r.outcome == "ok":
+            h.record(r.latency_s)
+            overall_hist.record(r.latency_s)
+        per_tenant[r.tenant] = per_tenant.get(r.tenant, 0) + 1
+
+    phases = {name: _phase_stats(rs, phase_hists[name])
+              for name, rs in by_phase.items()}
+    overall = _phase_stats(records, overall_hist)
+
+    bad = overall["failed"] + (overall["shed"] if count_shed_as_bad
+                               else 0)
+    avail_burn = error_budget_burn(overall["total"], bad,
+                                   availability_target)
+    report: Dict[str, Any] = {
+        "scenario": scenario,
+        "seed": seed,
+        "duration_s": round(duration_s, 3),
+        "phases": phases,
+        "overall": overall,
+        "tenants": per_tenant,
+        "slo": {
+            "availability_target": availability_target,
+            "count_shed_as_bad": count_shed_as_bad,
+            "availability_burn": (avail_burn if math.isfinite(avail_burn)
+                                  else -1.0),
+        },
+    }
+    if latency_target_ms is not None:
+        slow = sum(1 for r in records if r.outcome == "ok"
+                   and r.latency_s * 1e3 > latency_target_ms)
+        report["slo"]["latency_target_ms"] = latency_target_ms
+        report["slo"]["latency_over_target"] = slow
+        lat_burn = error_budget_burn(overall["admitted"], slow,
+                                     availability_target)
+        report["slo"]["latency_burn"] = (lat_burn
+                                         if math.isfinite(lat_burn)
+                                         else -1.0)
+    return report
+
+
+def ledger(records: Iterable[Any]) -> Dict[str, List[str]]:
+    """The client ledger: request ids grouped by observed outcome —
+    what the reconciliation pass joins against server records."""
+    out: Dict[str, List[str]] = {"ok": [], "shed": [], "failed": []}
+    for r in records:
+        out[r.outcome].append(r.rid)
+    return out

@@ -166,19 +166,58 @@ def _whole_block_q(s: int) -> int:
 def _attn_exact() -> bool:
     # RTPU_ATTN_EXACT=1 forces the streaming flash kernels (exact
     # running-max softmax) for workloads whose logits may exceed the
-    # whole-kv path's static cap (see _CAP_HI note above).  NOTE: the
-    # kernel choice is baked in at TRACE time — set the variable before
-    # the first jit of the attention shape; toggling it afterwards does
-    # not retrace cached programs.
+    # whole-kv path's static cap (see _CAP_HI note above). Prefer the
+    # explicit ``flash_attention(..., exact=True)`` kwarg — this env
+    # var is the global fallback for code that can't reach the call
+    # site, and is baked in at TRACE time (set it before the first jit
+    # of the attention shape; toggling afterwards does not retrace
+    # cached programs).
     import os
     return bool(os.environ.get("RTPU_ATTN_EXACT"))
 
 
-def _use_whole_kv(sq: int, sk: int, d: int) -> bool:
-    if _attn_exact():
+def _attn_debug() -> bool:
+    import os
+    return bool(os.environ.get("RTPU_ATTN_DEBUG"))
+
+
+def _use_whole_kv(sq: int, sk: int, d: int,
+                  exact: Optional[bool] = None) -> bool:
+    if _attn_exact() if exact is None else exact:
         return False
     return (sq == sk and sk <= _WHOLE_KV_MAX_S and d <= 128
             and sk % 128 == 0 and sq % _whole_block_q(sq) == 0)
+
+
+def _debug_check_logits(q_scaled, k):
+    """Debug-mode finite-range assert for the whole-kv fast path: the
+    static-shift softmax is exact only while every pre-softmax logit
+    stays under ``_CAP_HI`` — beyond it the clamp silently flattens the
+    distribution (and saturates gradients). With ``RTPU_ATTN_DEBUG=1``
+    (or ``flash_attention(..., debug=True)``) an out-of-range logit
+    fails loudly instead. Materializes the full score matrix — debug
+    cost, never on the production path."""
+    s_max = jnp.max(jax.lax.dot_general(
+        q_scaled, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32))
+
+    def _raise(m):
+        m = float(m)
+        if m > _CAP_HI:
+            raise FloatingPointError(
+                f"flash_attention whole-kv fast path: max scaled logit "
+                f"{m:.3f} exceeds the static softmax cap "
+                f"_CAP_HI={_CAP_HI} — the clamp would silently distort "
+                f"the distribution. Pass exact=True (or set "
+                f"RTPU_ATTN_EXACT=1) to use the exact streaming "
+                f"kernel, or rescale the logits.")
+
+    if isinstance(s_max, jax.core.Tracer):
+        # under jit the check runs at execution time via callback (the
+        # failure surfaces as a runtime callback error)
+        jax.debug.callback(_raise, s_max)
+    else:
+        _raise(s_max)
 
 
 def _whole_forward(q, k, v, causal, interpret=False):
@@ -545,32 +584,35 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
 # Public op with custom VJP
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                     exact):
     out, _ = _dispatch_forward(q, k, v, sm_scale, causal, block_q, block_k,
-                               interpret)
+                               interpret, exact)
     return out
 
 
 def _dispatch_forward(q, k, v, sm_scale, causal, block_q, block_k,
-                      interpret):
+                      interpret, exact=None):
     if sm_scale == 1.0 and _use_whole_kv(q.shape[2], k.shape[2],
-                                         q.shape[3]):
+                                         q.shape[3], exact):
         return _whole_forward(q, k, v, causal, interpret)
     return _flash_forward(q, k, v, sm_scale, causal, block_q, block_k,
                           interpret)
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                    exact):
     out, lse = _dispatch_forward(q, k, v, sm_scale, causal, block_q,
-                                 block_k, interpret)
+                                 block_k, interpret, exact)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, exact,
+                    res, g):
     q, k, v, out, lse = res
     if sm_scale == 1.0 and _use_whole_kv(q.shape[2], k.shape[2],
-                                         q.shape[3]):
+                                         q.shape[3], exact):
         return _whole_backward(res, g, causal=causal, interpret=interpret)
     return _flash_backward(res, g, sm_scale=sm_scale, causal=causal,
                            block_q=block_q, block_k=block_k,
@@ -585,11 +627,27 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     force_pallas: Optional[bool] = None,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    exact: Optional[bool] = None,
+                    debug: Optional[bool] = None):
     """Fused attention. [b, h, s, d] → [b, h, s, d].
 
     On TPU runs the Pallas kernel; elsewhere falls back to the XLA reference
-    (still fused reasonably by XLA on CPU for tests)."""
+    (still fused reasonably by XLA on CPU for tests).
+
+    ``exact`` picks the softmax numerics explicitly: ``True`` forces
+    the streaming flash kernels (exact running-max softmax — use for
+    workloads whose scaled logits may exceed the whole-kv path's
+    static ``_CAP_HI`` cap), ``False`` allows the whole-kv fast path
+    wherever its shape constraints hold, and ``None`` (default) defers
+    to the ``RTPU_ATTN_EXACT`` env var. Per-call and trace-stable,
+    unlike the env var, which only applies at first trace.
+
+    ``debug`` (default: env ``RTPU_ATTN_DEBUG``) adds a finite-range
+    assert when the whole-kv path is taken: any pre-softmax logit
+    above ``_CAP_HI`` raises ``FloatingPointError`` instead of being
+    silently clamped. Costs a full score-matrix pass — debugging only.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     use = _use_pallas() if force_pallas is None else force_pallas
@@ -619,5 +677,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # multiply, and autodiff routes the matching dq scale through it) so
     # the kernels skip a full [bq, block_k] multiply per kv block.
     q = (q * sm_scale).astype(q.dtype)
+    if (debug if debug is not None else _attn_debug()) and \
+            _use_whole_kv(sq, sk, q.shape[3], exact):
+        _debug_check_logits(q, k)
     return _flash_attention(q, k, v, 1.0, causal, block_q, block_k,
-                            interpret)
+                            interpret, exact)

@@ -327,6 +327,51 @@ def cmd_serve_shutdown(args):
     print("serve shut down")
 
 
+def cmd_gameday_list(args):
+    from ray_tpu.gameday import builtin_scenarios
+    for name, desc in sorted(builtin_scenarios().items()):
+        print(f"{name:16} {desc}")
+
+
+def cmd_gameday_run(args):
+    """`ray-tpu gameday run <scenario>`: one replayable game day on a
+    fresh local cluster — open-loop load + seeded faults + timed
+    actions, graded client-side and reconciled against the server
+    (docs/GAMEDAY.md). Exit code 0 iff the scenario passed."""
+    from ray_tpu.gameday import load_scenario, run_scenario
+    sc = load_scenario(args.scenario, seed=args.seed)
+    result = run_scenario(sc, scale=args.scale,
+                          dashboard_port=None if args.no_dashboard
+                          else 18470)
+    report = result.report
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(f"scenario {report['scenario']} @ seed {report['seed']} "
+              f"(scale {args.scale}) — "
+              f"{'PASSED' if report['passed'] else 'FAILED'}")
+        cols = ["phase", "total", "admitted", "shed", "failed",
+                "p50_ms", "p99_ms", "p999_ms", "max_ms"]
+        rows = [{"phase": n, **p}
+                for n, p in report.get("phases", {}).items()]
+        rows.append({"phase": "OVERALL", **report.get("overall", {})})
+        _print_table(rows, cols)
+        slo = report.get("slo", {})
+        print(f"availability burn {slo.get('availability_burn')} "
+              f"(target {slo.get('availability_target')})"
+              + (f"; latency burn {slo.get('latency_burn')} "
+                 f"(target p99 ≤ {slo.get('latency_target_ms')}ms)"
+                 if "latency_burn" in slo else ""))
+        for c in report.get("reconciliation", {}).get("checks", []):
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['name']}: {c['detail']}")
+        for err in report.get("action_errors", []):
+            print(f"  [FAIL] action: {err}")
+        if report.get("chaos_fired"):
+            print(f"  chaos fired: {report['chaos_fired']}")
+    sys.exit(0 if report.get("passed") else 1)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="ray-tpu",
@@ -442,6 +487,27 @@ def main(argv=None):
     sp = ssub.add_parser("shutdown")
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_serve_shutdown)
+
+    gdp = sub.add_parser(
+        "gameday",
+        help="replayable production-traffic SLO scenarios")
+    gsub = gdp.add_subparsers(dest="gameday_command", required=True)
+    sp = gsub.add_parser("run", help="run a scenario on a fresh "
+                                     "local cluster")
+    sp.add_argument("scenario",
+                    help="builtin name (see `gameday list`) or a JSON "
+                         "spec path")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="override the scenario seed (same seed = "
+                         "same arrivals + fault schedule)")
+    sp.add_argument("--scale", type=float, default=1.0,
+                    help="stretch/shrink phase durations")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--no-dashboard", action="store_true",
+                    help="skip the dashboard + Prometheus cross-check")
+    sp.set_defaults(func=cmd_gameday_run)
+    sp = gsub.add_parser("list", help="list builtin scenarios")
+    sp.set_defaults(func=cmd_gameday_list)
 
     args = p.parse_args(argv)
     args.func(args)

@@ -25,6 +25,16 @@ draining replica only once ``queue_len`` hits zero or the deployment's
 Chaos site: ``serve.replica.request`` fires per accepted request
 (method = the deployment name), so a seeded schedule can SIGKILL one
 replica at an exact request count (``RTPU_CHAOS`` op ``kill``).
+
+Request ids: a caller may tag a request with the reserved
+``__rtpu_request_id__`` kwarg (the HTTP proxy maps the ``X-Request-Id``
+header onto it). The replica strips it before user code runs, records
+(id, outcome, latency) in a bounded per-replica request ledger, and
+echoes it in the proxy's response envelope — the join key the game-day
+reconciliation pass (``ray_tpu/gameday/reconcile.py``) uses to match
+client-observed outcomes against server records. On graceful shutdown
+the ledger is flushed to the GCS KV so replicas retired by a rolling
+update keep their half of the join.
 """
 
 from __future__ import annotations
@@ -42,6 +52,10 @@ from ray_tpu.serve.exceptions import ReplicaOverloadedError
 # within ~10 requests.
 _EWMA_ALPHA = 0.3
 
+# reserved kwarg carrying a client-supplied request id; stripped before
+# the user callable sees kwargs
+REQUEST_ID_KWARG = "__rtpu_request_id__"
+
 
 def _default_max_queued(max_concurrent_queries: int) -> int:
     env = os.environ.get("RTPU_SERVE_MAX_QUEUED")
@@ -53,6 +67,14 @@ def _default_max_queued(max_concurrent_queries: int) -> int:
     return 2 * max_concurrent_queries
 
 
+def _request_log_max() -> int:
+    try:
+        return max(0, int(os.environ.get("RTPU_SERVE_REQUEST_LOG_MAX",
+                                         65536)))
+    except ValueError:
+        return 65536
+
+
 class ReplicaActor:
     """Generic wrapper the controller instantiates as an actor."""
 
@@ -61,10 +83,12 @@ class ReplicaActor:
                  user_config: Optional[Any] = None,
                  version: str = "",
                  max_concurrent_queries: int = 100,
-                 max_queued_requests: Optional[int] = None):
+                 max_queued_requests: Optional[int] = None,
+                 replica_name: Optional[str] = None):
         import cloudpickle
         self.deployment_name = deployment_name
         self.version = version
+        self.replica_name = replica_name or f"{deployment_name}#{os.getpid()}"
         fn_or_cls = cloudpickle.loads(serialized_callable)
         if isinstance(fn_or_cls, type):
             self.callable = fn_or_cls(*init_args, **init_kwargs)
@@ -93,6 +117,11 @@ class ReplicaActor:
         # dashboard serve panel): last 512 service times, O(1) record
         from collections import deque
         self._lat_ring = deque(maxlen=512)
+        # per-request ledger: (request_id, outcome, latency_s) for every
+        # admitted/shed request — the server half of the game-day
+        # reconciliation join. Bounded; overflow is flagged, not silent.
+        self._request_log = deque(maxlen=_request_log_max() or 1)
+        self._request_log_dropped = 0
         if user_config is not None:
             self.reconfigure(user_config)
         # bucket-prewarm hook: a callable may define __serve_prewarm__
@@ -117,12 +146,26 @@ class ReplicaActor:
                                  kwargs: dict) -> Dict[str, Any]:
         """Proxy path: the result envelope piggybacks this replica's
         current load so the proxy's router sees queue depth at response
-        latency, not at the next long-poll tick."""
+        latency, not at the next long-poll tick — and echoes the
+        request id so the caller can correlate response to request."""
+        rid = kwargs.get(REQUEST_ID_KWARG) if kwargs else None
         result = self._execute(method_name, args, kwargs)
-        return {"__serve_result__": result, "__serve_load__": self.get_load()}
+        envelope = {"__serve_result__": result,
+                    "__serve_load__": self.get_load()}
+        if rid is not None:
+            envelope["__serve_request_id__"] = rid
+        return envelope
+
+    def _record_request_locked(self, rid: Optional[str], outcome: str,
+                               dt: float):
+        """Append one ledger entry (caller holds ``_ongoing_lock``)."""
+        if len(self._request_log) == self._request_log.maxlen:
+            self._request_log_dropped += 1
+        self._request_log.append((rid, outcome, round(dt, 6)))
 
     def _execute(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         t0 = time.monotonic()
+        rid = kwargs.pop(REQUEST_ID_KWARG, None) if kwargs else None
         if chaos._ENGINE is not None:
             # chaos injection point: "kill" at the N-th request this
             # replica accepted (method filter = deployment name)
@@ -130,15 +173,13 @@ class ReplicaActor:
         with self._ongoing_lock:
             in_flight = self._ongoing + self._queued
             limit = self._max_concurrent + self._max_queued
-            if self._draining:
+            if self._draining or in_flight >= limit:
                 # a draining replica finishes what it has but takes no
-                # new work — shed retriably so the router re-routes to
-                # a replica still in the published table
+                # new work; a full replica sheds — both retriable, so
+                # the router re-routes to a replica still in the
+                # published table
                 self._total_shed += 1
-                raise ReplicaOverloadedError(self.deployment_name,
-                                             in_flight, limit)
-            if in_flight >= limit:
-                self._total_shed += 1
+                self._record_request_locked(rid, "shed", 0.0)
                 raise ReplicaOverloadedError(self.deployment_name,
                                              in_flight, limit)
             self._queued += 1
@@ -147,6 +188,7 @@ class ReplicaActor:
         with self._ongoing_lock:
             self._queued -= 1
             self._ongoing += 1
+        outcome = "ok"
         try:
             if self._is_function:
                 target = self.callable
@@ -154,6 +196,7 @@ class ReplicaActor:
                 target = getattr(self.callable, method_name or "__call__")
             return target(*args, **kwargs)
         except Exception:
+            outcome = "error"
             with self._ongoing_lock:
                 self._total_errors += 1
             raise
@@ -168,6 +211,7 @@ class ReplicaActor:
                 else:
                     self._ewma_s, self._have_ewma = dt, True
                 self._lat_ring.append(dt)
+                self._record_request_locked(rid, outcome, dt)
 
     # ---- control plane ----
 
@@ -209,6 +253,18 @@ class ReplicaActor:
         return {"deployment": self.deployment_name,
                 "version": self.version}
 
+    def get_request_log(self) -> Dict[str, Any]:
+        """This replica's request ledger: every admitted/shed request
+        as (request_id, outcome, latency_s), ``outcome`` in
+        ok|error|shed. ``truncated`` means the bounded log overflowed
+        (raise ``RTPU_SERVE_REQUEST_LOG_MAX``) — per-request joins are
+        then unreliable and reconciliation says so."""
+        with self._ongoing_lock:
+            return {"deployment": self.deployment_name,
+                    "replica": self.replica_name,
+                    "records": list(self._request_log),
+                    "truncated": self._request_log_dropped > 0}
+
     def prepare_drain(self) -> str:
         """Graceful-drain step 2 (step 1 removed us from the route
         table): stop accepting new requests; in-flight ones finish."""
@@ -242,6 +298,31 @@ class ReplicaActor:
         return "ok"
 
     def prepare_for_shutdown(self):
+        # drain this process's task-event ring synchronously: the
+        # controller kills us right after this RPC returns, and the
+        # FINISHED events of our last requests (≤0.5 s of batching)
+        # must reach the GCS for the state engine to agree with the
+        # client ledger (gameday reconciliation check C6)
+        try:
+            from ray_tpu._private import task_events as tev
+            tev.flush_all(timeout=2.0)
+        except Exception:
+            pass
+        # flush the request ledger before dying: a replica retired by a
+        # rolling update / downscale must not take the server half of
+        # the game-day reconciliation join with it (best-effort — a KV
+        # outage degrades observability, never shutdown)
+        try:
+            with self._ongoing_lock:
+                records = list(self._request_log)
+                truncated = self._request_log_dropped > 0
+            if records:
+                from ray_tpu.gameday import store
+                store.flush_replica_ledger(
+                    self.replica_name, self.deployment_name,
+                    records, truncated=truncated)
+        except Exception:
+            pass
         if not self._is_function and hasattr(self.callable, "__del__"):
             try:
                 self.callable.__del__()

@@ -309,7 +309,8 @@ class Router:
                         args: tuple, kwargs: dict, *,
                         get_timeout: float = 60.0,
                         assign_timeout: float = 30.0,
-                        overload_retries: Optional[int] = None) -> Any:
+                        overload_retries: Optional[int] = None,
+                        request_id: Optional[str] = None) -> Any:
         """Synchronous request with overload retry — the proxy hot path.
 
         Uses the replica's envelope method so each response piggybacks
@@ -318,7 +319,18 @@ class Router:
         up to ``overload_retries`` times (env
         ``RTPU_SERVE_OVERLOAD_RETRIES``, default 3); exhaustion
         re-raises the overload error for the caller to map (the HTTP
-        proxy returns 503)."""
+        proxy returns 503).
+
+        ``request_id`` tags the request end to end: it rides the
+        reserved ``__rtpu_request_id__`` kwarg into the replica (which
+        strips it, ledgers it, and echoes it in the envelope), and
+        every overload retry reuses the SAME id — retries are one
+        logical request, and the per-request join in
+        ``gameday/reconcile.py`` counts them that way (N shed records
+        + at most one completion for one id)."""
+        if request_id is not None:
+            from ray_tpu.serve._private.replica import REQUEST_ID_KWARG
+            kwargs = {**(kwargs or {}), REQUEST_ID_KWARG: request_id}
         if overload_retries is None:
             try:
                 overload_retries = int(os.environ.get(

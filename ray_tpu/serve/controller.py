@@ -611,7 +611,8 @@ class ServeController:
             user_config=cfg.get("user_config"),
             version=info.version,
             max_concurrent_queries=mcq,
-            max_queued_requests=max_queued)
+            max_queued_requests=max_queued,
+            replica_name=replica_name)
         info.replicas[h] = info.version
         info.replica_names[h._id_hex] = replica_name
         return h

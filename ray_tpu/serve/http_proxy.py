@@ -96,6 +96,11 @@ class HTTPProxyActor:
 
             def _handle(self, body: Optional[bytes]):
                 import ray_tpu
+                # client-supplied request id: propagated proxy → router
+                # → replica (reserved __rtpu_request_id__ kwarg) and
+                # echoed on EVERY response, so a client can join its
+                # observation with the replica's request ledger
+                self._request_id = self.headers.get("X-Request-Id")
                 parsed = urlparse(self.path)
                 matched = proxy._match(parsed.path)
                 if matched is None:
@@ -146,7 +151,8 @@ class HTTPProxyActor:
                             name, "__call__",
                             (payload,) if payload is not None else (),
                             kwargs, get_timeout=60.0,
-                            assign_timeout=assign_timeout)
+                            assign_timeout=assign_timeout,
+                            request_id=self._request_id)
                         if isinstance(result, dict) and \
                                 "__serve_http_status__" in result:
                             # structured routing miss from an ingress
@@ -220,6 +226,8 @@ class HTTPProxyActor:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if getattr(self, "_request_id", None):
+                    self.send_header("X-Request-Id", self._request_id)
                 self.end_headers()
                 self.wfile.write(data)
 

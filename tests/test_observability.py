@@ -511,6 +511,92 @@ def test_dashboard_state_routes(cluster):
         assert marker in html
 
 
+def test_dashboard_profile_flamegraph_endpoint(cluster):
+    """The timed-sampling flamegraph endpoint (VERDICT: shipped
+    untested): folded-stack output in the collapsed format
+    flamegraph.pl / speedscope import — 'frame;frame;frame count'."""
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    port = start_dashboard(port=18265)
+
+    # keep a worker busy so the sampler has a stack to fold
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.time()
+        n = 0
+        while time.time() - t0 < sec:
+            n += 1
+        return n
+
+    ref = spin.remote(3.0)
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/profile/flamegraph?duration_s=1.0",
+        timeout=60).read())
+    ray_tpu.get(ref, timeout=60)
+    workers = [w for n in doc.get("nodes", [])
+               for w in n.get("workers", []) if not w.get("error")]
+    assert workers, doc
+    profiled = [w for w in workers if w.get("folded")]
+    assert profiled, workers
+    for w in profiled:
+        assert w.get("samples", 0) >= 1
+        line = w["folded"].strip().splitlines()[0]
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit(), line
+    # the spinning worker shows a multi-frame folded stack
+    assert any(";" in w["folded"] for w in profiled), profiled
+
+    stacks = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/profile/stacks",
+        timeout=60).read())
+    assert stacks.get("nodes"), stacks
+
+
+def test_dashboard_gameday_panel_and_slo_gauges(cluster):
+    """The game-day surface: /api/gameday serves the last published
+    report, /metrics exports the ray_tpu_slo_* gauges from it, and the
+    frontend carries the panel."""
+    from ray_tpu.dashboard.dashboard import start_dashboard
+    from ray_tpu.gameday import store
+    port = start_dashboard(port=18265)
+
+    report = {
+        "scenario": "unit", "seed": 7, "duration_s": 1.0,
+        "phases": {"peak": {"total": 100, "admitted": 99, "shed": 1,
+                            "failed": 0, "p50_ms": 4.0, "p99_ms": 20.0,
+                            "p999_ms": 35.0, "max_ms": 40.0,
+                            "mean_ms": 5.0}},
+        "overall": {"total": 100, "admitted": 99, "shed": 1,
+                    "failed": 0, "p50_ms": 4.0, "p99_ms": 20.0,
+                    "p999_ms": 35.0, "max_ms": 40.0, "mean_ms": 5.0},
+        "slo": {"availability_target": 0.999, "availability_burn": 0.0,
+                "latency_target_ms": 250.0, "latency_burn": 0.2},
+        "reconciliation": {"ok": True, "checks": []},
+        "passed": True,
+    }
+    assert store.publish_report(report)
+
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/api/gameday", timeout=30).read())
+    assert doc["report"]["scenario"] == "unit"
+    assert doc["report"]["overall"]["admitted"] == 99
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+    assert ('ray_tpu_slo_requests{scenario="unit",phase="peak",'
+            'outcome="admitted"} 99.0') in text
+    assert ('ray_tpu_slo_latency_p99_seconds{scenario="unit",'
+            'phase="peak"} 0.02') in text
+    assert ('ray_tpu_slo_error_budget_burn{scenario="unit",'
+            'slo="latency"} 0.2') in text
+    assert 'ray_tpu_slo_reconcile_ok{scenario="unit"} 1.0' in text
+    assert 'ray_tpu_slo_passed{scenario="unit"} 1.0' in text
+
+    html = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=30).read().decode()
+    for marker in ("Game day", "/api/gameday", "gd-tiles"):
+        assert marker in html
+
+
 _CHAOS_LISTING_SCRIPT = r"""
 import json, time
 import ray_tpu

@@ -173,6 +173,82 @@ def test_flash_attention_whole_vs_streaming_paths(monkeypatch):
                                    atol=5e-5, rtol=5e-5)
 
 
+def test_flash_attention_exact_kwarg_overrides_env(monkeypatch):
+    """`exact=` picks the softmax numerics per call (ADVICE round 5:
+    the env var was trace-time-only): exact=True forces the streaming
+    kernels, exact=False allows the whole-kv fast path, None defers to
+    RTPU_ATTN_EXACT — and both paths agree with the reference."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops import attention as A
+
+    assert A._use_whole_kv(256, 256, 64)
+    assert not A._use_whole_kv(256, 256, 64, True)
+    assert A._use_whole_kv(256, 256, 64, False)
+    # an explicit exact=False overrides even the env var
+    monkeypatch.setenv("RTPU_ATTN_EXACT", "1")
+    assert not A._use_whole_kv(256, 256, 64)  # env applies when None
+    assert A._use_whole_kv(256, 256, 64, False)
+    monkeypatch.delenv("RTPU_ATTN_EXACT")
+
+    rng = jax.random.PRNGKey(11)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (1, 2, 256, 64)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    ref = A.attention_reference(q, k, v, causal=True)
+    for exact in (True, False):
+        out = A.flash_attention(q, k, v, causal=True, force_pallas=True,
+                                interpret=True, block_q=128, block_k=128,
+                                exact=exact)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        g = jax.grad(lambda q, k, v: A.flash_attention(
+            q, k, v, causal=True, force_pallas=True, interpret=True,
+            block_q=128, block_k=128, exact=exact).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q, k, v: A.attention_reference(
+            q, k, v, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+
+def test_flash_attention_debug_asserts_on_capped_logits():
+    """Debug mode (kwarg or RTPU_ATTN_DEBUG) fails LOUDLY when a logit
+    would be silently clamped by the whole-kv path's static cap —
+    and stays quiet for in-range logits or the exact streaming path."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from ray_tpu.ops import attention as A
+
+    rng = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (1, 1, 128, 64)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+
+    # in-range logits: debug mode is silent
+    A.flash_attention(q, k, v, causal=True, force_pallas=True,
+                      interpret=True, block_q=64, block_k=64, debug=True)
+
+    # blown-up logits on the capped fast path: loud failure
+    with pytest.raises(FloatingPointError, match="_CAP_HI"):
+        A.flash_attention(q * 100.0, k, v, causal=True,
+                          force_pallas=True, interpret=True,
+                          block_q=64, block_k=64, debug=True)
+
+    # the exact streaming path has no cap — same inputs pass
+    out = A.flash_attention(q * 100.0, k, v, causal=True,
+                            force_pallas=True, interpret=True,
+                            block_q=64, block_k=64, debug=True,
+                            exact=True)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_ring_attention_matches_full(cpu_mesh8):
     import jax
     import jax.numpy as jnp
