@@ -709,6 +709,91 @@ def _chaos_bench_main():
 # ------------------------------------------------------ state-engine bench
 
 
+def _dag_bench_main():
+    """Compiled-DAG bench (_BENCH_DAG=1): 3-stage actor pipeline,
+    compiled channels vs dynamic ``.execute()`` dispatch (ROADMAP item
+    3; gates >=5x per-hop latency and >=3x pipelined throughput on the
+    1-core CI box). Also reports a 256 KB-payload variant (plasmax
+    ring-slot path) and the ring-reuse segment delta. One JSON line;
+    recorded in PERF.md."""
+    import statistics
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import worker as wmod
+    from ray_tpu.dag import InputNode
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    out = {}
+    try:
+        @ray_tpu.remote
+        class Stage:
+            def step(self, x):
+                return x
+
+        with InputNode() as inp:
+            s1, s2, s3 = Stage.bind(), Stage.bind(), Stage.bind()
+            pipe = s3.step.bind(s2.step.bind(s1.step.bind(inp)))
+
+        def lat(fn, n):
+            xs = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                xs.append(time.perf_counter() - t0)
+            return statistics.median(xs)
+
+        # dynamic: per-exec latency + pipelined throughput (refs
+        # submitted without waiting, gathered in one get)
+        ray_tpu.get(pipe.execute(0))  # actor warmup
+        dyn_exec_s = lat(lambda: ray_tpu.get(pipe.execute(0)), 50)
+        n = 200
+        t0 = time.perf_counter()
+        refs = [pipe.execute(i) for i in range(n)]
+        ray_tpu.get(refs, timeout=300)
+        dyn_rate = n / (time.perf_counter() - t0)
+
+        cpipe = pipe.compile()
+        assert cpipe._compiled, "pipeline failed to compile"
+        cpipe.execute(0)  # channel warmup
+        cmp_exec_s = lat(lambda: cpipe.execute(0), 200)
+        t0 = time.perf_counter()
+        futs = [cpipe.execute_async(i) for i in range(1000)]
+        for f in futs:
+            f.result(60)
+        cmp_rate = 1000 / (time.perf_counter() - t0)
+
+        out["dynamic_per_hop_us"] = round(1e6 * dyn_exec_s / 3, 1)
+        out["compiled_per_hop_us"] = round(1e6 * cmp_exec_s / 3, 1)
+        out["per_hop_speedup"] = round(dyn_exec_s / cmp_exec_s, 2)
+        out["dynamic_pipelined_per_s"] = round(dyn_rate, 1)
+        out["compiled_pipelined_per_s"] = round(cmp_rate, 1)
+        out["throughput_speedup"] = round(cmp_rate / dyn_rate, 2)
+
+        # 256 KB activations through the plasmax ring slots: steady-state
+        # latency + the segment-usage delta across 100 triggers (must be
+        # flat — seal/unseal reuse, docs/COMPILED_DAGS.md)
+        arr = np.zeros(32 * 1024, dtype=np.float64)
+        for _ in range(4):  # >= ring depth: lazy slots exist before t0
+            cpipe.execute(arr)
+        w = wmod._global_worker
+        s0 = w.plasma.stats()
+        big_s = lat(lambda: cpipe.execute(arr), 100)
+        s1_ = w.plasma.stats()
+        out["compiled_256k_per_hop_us"] = round(1e6 * big_s / 3, 1)
+        out["ring_used_bytes_delta"] = \
+            s1_["used_bytes"] - s0["used_bytes"]
+        out["ring_created_delta"] = \
+            s1_["num_created"] - s0["num_created"]
+        cpipe.teardown()
+    finally:
+        ray_tpu.shutdown()
+    out["gate_per_hop_5x"] = out["per_hop_speedup"] >= 5.0
+    out["gate_throughput_3x"] = out["throughput_speedup"] >= 3.0
+    print(json.dumps({"metric": "compiled_dag", **out}), flush=True)
+
+
 def _state_bench_main():
     """State-engine microbench (_BENCH_STATE=1): with 10k+ drained
     tasks in the GCS task table, measure (a) list_tasks first-page p50
@@ -1275,6 +1360,12 @@ def main():
     elif os.environ.get("_BENCH_STATE"):
         try:
             _state_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_DAG"):
+        try:
+            _dag_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -46,6 +46,18 @@ Sites wired through the runtime:
     serve.replica.request           kill (SIGKILL one serve replica at
                                     the N-th accepted request; method
                                     filter = deployment name)
+    dag.channel                     kill | reset | drop | delay
+                                    (compiled-DAG channel frames,
+                                    ray_tpu/dag/channel.py: ``kill``
+                                    SIGKILLs the stage worker mid-graph,
+                                    ``reset`` severs the peer channel,
+                                    ``drop``/``delay`` lose/stall one
+                                    frame; method filter = frame method,
+                                    dag_exec / dag_result)
+    dag.stage                       kill (SIGKILL the worker hosting one
+                                    specific compiled-DAG stage at its
+                                    N-th execution; method filter = the
+                                    stage id as a string)
 
 Every fired fault is appended to the chaos log (``RTPU_CHAOS_LOG`` path;
 JSONL of ``{n, site, op, method, seq, ts}`` — everything except ``ts``

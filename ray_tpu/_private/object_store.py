@@ -57,6 +57,9 @@ def _lib() -> ctypes.CDLL:
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.px_unseal.restype = ctypes.c_int
+        lib.px_unseal.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
         for name in ("px_used_bytes", "px_capacity", "px_num_objects",
                      "px_num_evicted"):
             fn = getattr(lib, name)
@@ -252,6 +255,61 @@ class PlasmaxStore:
             return True
         fb = self._fb()
         return fb.pin(oid) if fb is not None else False
+
+    # -- ring buffers (compiled-DAG channels) --
+    #
+    # A ring slot is a plasmax object the WRITER owns for the lifetime of a
+    # compiled graph: created once (keeping the creator's pin so LRU eviction
+    # can never reclaim it), then cycled seal→unseal→refill→seal per
+    # invocation instead of create-per-object. px_unseal rewrites in place —
+    # no allocator traffic, so used_bytes/num_created stay flat across
+    # repeated graph executions (the property tests/test_compiled_dag.py
+    # gates). Readers use the normal get_buffer/release pair; unseal refuses
+    # (-2) while any reader still holds a ref and the writer retries.
+
+    def ring_create(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate a reusable slot; the creator pin is KEPT across seal."""
+        off = ctypes.c_uint64()
+        rc = self._lib.px_create(self._base, oid.binary(), size,
+                                 ctypes.byref(off))
+        if rc == -1:
+            raise ValueError(f"ring slot {oid} already exists")
+        if rc in (-2, -3):
+            raise ObjectStoreFullError(
+                f"cannot allocate {size}-byte ring slot")
+        return memoryview(self._mm)[off.value:off.value + size]
+
+    def ring_seal(self, oid: ObjectID):
+        """Seal WITHOUT dropping the creator pin (unlike seal())."""
+        rc = self._lib.px_seal(self._base, oid.binary())
+        if rc != 0:
+            raise ValueError(f"ring seal failed for {oid}: {rc}")
+
+    def ring_recycle(self, oid: ObjectID,
+                     timeout: float = 5.0) -> Optional[memoryview]:
+        """Unseal a slot for rewrite; blocks until readers release (or
+        timeout → None, caller falls back to an inline send)."""
+        import time as _time
+        off = ctypes.c_uint64()
+        deadline = _time.monotonic() + timeout
+        while True:
+            rc = self._lib.px_unseal(self._base, oid.binary(),
+                                     ctypes.byref(off))
+            if rc == 0:
+                # slot size is fixed at ring_create; callers slice the view
+                # to the size they tracked
+                return memoryview(self._mm)[off.value:]
+            if rc == -1:
+                return None  # gone (evicted segment teardown) — inline
+            if _time.monotonic() >= deadline:
+                return None  # reader wedged: skip the slot this round
+            _time.sleep(0.0002)
+
+    def ring_free(self, oid: ObjectID):
+        """Teardown: drop the creator pin; delete if no readers remain
+        (otherwise the slot becomes ordinary evictable garbage)."""
+        self._lib.px_release(self._base, oid.binary())
+        self._lib.px_delete(self._base, oid.binary())
 
     # -- stats --
 

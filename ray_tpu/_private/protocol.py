@@ -46,6 +46,29 @@ async def read_frame(reader: asyncio.StreamReader):
     return msgpack.unpackb(data, raw=False)
 
 
+def read_frame_sync(sock) -> Any:
+    """Blocking-socket twin of read_frame — same framing, no event loop.
+    The compiled-DAG channel threads (ray_tpu/dag/channel.py) speak the
+    wire protocol over dedicated sockets owned by plain threads, so the
+    forward path never touches an asyncio loop."""
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack("<I", hdr)
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame too large: {n}")
+    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    parts = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("peer closed")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts) if len(parts) != 1 else parts[0]
+
+
 class RpcError(Exception):
     pass
 

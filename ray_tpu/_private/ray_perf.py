@@ -124,6 +124,41 @@ def main(json_path: Optional[str] = None) -> Dict[str, float]:
         "wait on 100 ready refs (waits/s)",
         lambda: ray_tpu.wait(refs, num_returns=100, timeout=10)))
 
+    # ------------------------------------------------- compiled actor DAGs
+    # 3-stage pipeline, compiled vs dynamic (ROADMAP item 3: amortized
+    # dispatch for static topologies; docs/COMPILED_DAGS.md)
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x
+
+    from ray_tpu.dag import InputNode
+    with InputNode() as inp:
+        s1, s2, s3 = Stage.bind(), Stage.bind(), Stage.bind()
+        pipe = s3.step.bind(s2.step.bind(s1.step.bind(inp)))
+
+    ray_tpu.get(pipe.execute(0))  # create the actors before timing
+
+    results.append(timeit(
+        "3-stage pipeline dynamic (execs/s)",
+        lambda: ray_tpu.get(pipe.execute(0))))
+
+    cpipe = pipe.compile()
+    if cpipe._compiled:
+        results.append(timeit(
+            "3-stage pipeline compiled (execs/s)",
+            lambda: cpipe.execute(0)))
+
+        def pipelined_batch():
+            futs = [cpipe.execute_async(0) for _ in range(100)]
+            for f in futs:
+                f.result(30)
+
+        results.append(timeit(
+            "3-stage pipeline compiled pipelined (execs/s)",
+            pipelined_batch, multiplier=100, reps=2, window_s=2.0))
+    cpipe.teardown()
+
     ray_tpu.shutdown()
 
     summary = {name: mean for name, mean, _ in results}

@@ -333,6 +333,11 @@ class Raylet:
         self._worker_counter = 0
         self._running_tasks: Dict[str, Tuple[WorkerHandle, PendingTask]] = {}
         self._oom_killed_workers: Set[str] = set()
+        # compiled-DAG stages hosted per worker: wid -> {dag_id: owner}.
+        # On worker death every owner gets a dag_peer_down notify so its
+        # CompiledDAG tears down + falls back immediately instead of
+        # waiting out an execute timeout (ray_tpu/dag/compiled_dag.py).
+        self._dag_stages: Dict[str, Dict[str, str]] = {}
         # content-addressed, shared across sessions on this host (reference:
         # runtime_env URI cache with refcounting; here cache entries are
         # immutable-by-hash so no refcounts are needed)
@@ -370,6 +375,8 @@ class Raylet:
             "release_lease": self.handle_release_lease,
             "task_stats": self.handle_task_stats,
             "preempt": self.handle_preempt,
+            "dag_register": self.handle_dag_register,
+            "dag_unregister": self.handle_dag_unregister,
             "_on_disconnect": self._on_disconnect,
         }
 
@@ -629,8 +636,32 @@ class Raylet:
 
         ev.report(severity, label, message, gcs_notify=_notify, **fields)
 
+    async def handle_dag_register(self, payload, conn):
+        """A worker opened a compiled-DAG stage: remember (dag, owner) so
+        its death can be pushed to the compiling driver."""
+        wid = conn.meta.get("worker_id")
+        if wid:
+            self._dag_stages.setdefault(wid, {})[payload["dag_id"]] = \
+                payload.get("owner_address") or ""
+        return {}
+
+    async def handle_dag_unregister(self, payload, conn):
+        wid = conn.meta.get("worker_id")
+        if wid and wid in self._dag_stages:
+            self._dag_stages[wid].pop(payload.get("dag_id"), None)
+            if not self._dag_stages[wid]:
+                del self._dag_stages[wid]
+        return {}
+
     async def _handle_worker_death(self, worker_id: str, reason: str):
         self._clean_leases_for_worker(worker_id)
+        # compiled-DAG teardown: the owner falls back to dynamic dispatch
+        # and re-compiles on its next call
+        for dag_id, owner in (self._dag_stages.pop(worker_id, None)
+                              or {}).items():
+            if owner:
+                protocol.spawn(self._notify_dag_owner(
+                    owner, dag_id, worker_id))
         handle = self.workers.pop(worker_id, None)
         if handle is None:
             return
@@ -684,6 +715,19 @@ class Raylet:
             except Exception:
                 pass
         self._dispatch_event.set()
+
+    async def _notify_dag_owner(self, owner: str, dag_id: str,
+                                worker_id: str):
+        try:
+            conn = await protocol.connect(owner)
+            try:
+                await conn.notify("dag_peer_down",
+                                  {"dag_id": dag_id,
+                                   "worker_id": worker_id})
+            finally:
+                conn.close()
+        except Exception:
+            pass  # owner gone too — nothing to tear down
 
     async def _notify_owner_task_failed(self, owner: str, task_id: str,
                                         msg: Dict[str, Any]):

@@ -43,7 +43,14 @@ from typing import Any, Dict, List, Optional, Tuple
 #      summarize/summarize_tasks GCS methods, raylet-side list_objects,
 #      cursor pagination fields (paged/limit/continuation_token/filters)
 #      on every list_* method (legacy non-paged replies retained).
-PROTOCOL_VERSION = (1, 4)
+# 1.5: compiled-DAG channels — dag_channel_open/dag_channel_close on
+#      workers, dag_register/dag_unregister on raylets, dag_stage_error/
+#      dag_peer_down owner notifies, and the dag_exec/dag_result frames
+#      that ride the dedicated channel sockets. Channel opens are gated
+#      on the peer having negotiated >= 1.5 via __hello__ (a legacy peer
+#      degrades the whole graph to dynamic dispatch — docs/
+#      COMPILED_DAGS.md).
+PROTOCOL_VERSION = (1, 5)
 
 _str = str
 _num = numbers.Number
@@ -199,6 +206,35 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "processed_up_to": (_int, False),
         "caller": (_str, False),
     },
+    # ---- compiled-DAG channels (1.5; docs/COMPILED_DAGS.md). The
+    # control-plane trio (open/close/register) rides the normal RPC
+    # surface; dag_exec/dag_result are declared here for the conformance
+    # vectors but flow over the dedicated channel sockets.
+    "dag_channel_open": {
+        "dag_id": (_str, True),
+        "stage_id": (_int, True),
+        "method": (_str, True),
+        "args_tpl": (_list, True),
+        "kwargs_tpl": (_dict, False),
+        "downstream": (_list, True),
+        "owner_address": (_str, True),
+        "ring": (_dict, False),
+    },
+    "dag_channel_close": {"dag_id": (_str, True),
+                          "stage_id": (_int, False)},
+    "dag_register": {"dag_id": (_str, True),
+                     "owner_address": (_str, False)},
+    "dag_unregister": {"dag_id": (_str, True)},
+    "dag_stage_error": {"dag_id": (_str, True), "stage_id": (_int, False),
+                        "seq": (_int, False), "reason": (_str, False)},
+    "dag_peer_down": {"dag_id": (_str, True),
+                      "worker_id": (_str, False)},
+    "dag_exec": {"d": (_str, True), "t": (_int, True), "s": (_int, True),
+                 "b": (_bytes, False), "o": (_str, False),
+                 "n": (_int, False)},
+    "dag_result": {"d": (_str, True), "s": (_int, True), "i": (_int, True),
+                   "ae": (_bool, False), "b": (_bytes, False),
+                   "o": (_str, False), "n": (_int, False)},
     # ---- worker lifecycle (the second-language worker surface —
     # docs/WIRE_PROTOCOL.md declares this table normative for it)
     "worker_register": {"worker_id": (_str, True),

@@ -556,6 +556,15 @@ class Worker:
         except Exception:
             pass
         self.connected = False
+        # compiled-DAG channels: close the listener + stage sockets and
+        # free the plasmax ring slots before the store goes away
+        ep = getattr(self, "_dag_endpoint", None)
+        if ep is not None:
+            self._dag_endpoint = None
+            try:
+                ep.close()
+            except Exception:
+                pass
         if self._server is not None:
             self._server.close()
         if self.io is not None:
@@ -580,6 +589,10 @@ class Worker:
             "borrow_del": self._h_borrow_del,
             "exit_worker": self._h_exit_worker,
             "preemption_notice": self._h_preemption_notice,
+            "dag_channel_open": self._h_dag_channel_open,
+            "dag_channel_close": self._h_dag_channel_close,
+            "dag_stage_error": self._h_dag_stage_error,
+            "dag_peer_down": self._h_dag_peer_down,
             "ping": self._h_ping,
             "pubsub": self._h_pubsub,
             "dump_stacks": self._h_dump_stacks,
@@ -1737,6 +1750,57 @@ class Worker:
 
     async def _h_exit_worker(self, payload, conn):
         os._exit(0)
+
+    # ---- compiled-DAG channels (ray_tpu/dag/channel.py; schema 1.5) ----
+
+    async def _h_dag_channel_open(self, payload, conn):
+        """Pre-wire one compiled-DAG stage in this (actor) worker: build
+        the stage runtime, dial its downstream peers, and hand back this
+        process's channel address. The raylet learns about the stage so
+        a worker death reaches the compiling owner (dag_peer_down)
+        without waiting out an execute timeout."""
+        from ray_tpu.dag import channel as dagch
+        loop = asyncio.get_running_loop()
+        ep = dagch.get_endpoint(self)
+        # dialing downstream peers is blocking socket work — keep it off
+        # the io loop
+        r = await loop.run_in_executor(None, ep.open_stage, payload)
+        if self.raylet is not None:
+            try:
+                await self.raylet.notify("dag_register", {
+                    "dag_id": payload["dag_id"],
+                    "owner_address": payload["owner_address"]})
+            except Exception:
+                pass
+        return r
+
+    async def _h_dag_channel_close(self, payload, conn):
+        ep = getattr(self, "_dag_endpoint", None)
+        if ep is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, ep.close_stage, payload["dag_id"],
+                payload.get("stage_id"))
+        if self.raylet is not None:
+            try:
+                await self.raylet.notify(
+                    "dag_unregister", {"dag_id": payload["dag_id"]})
+            except Exception:
+                pass
+        return {}
+
+    async def _h_dag_stage_error(self, payload, conn):
+        """A stage's forward send broke (downstream peer died): the
+        compiling owner tears the graph down and falls back."""
+        from ray_tpu.dag import compiled_dag
+        compiled_dag.on_stage_error(payload)
+        return {}
+
+    async def _h_dag_peer_down(self, payload, conn):
+        """Raylet-side death detection for a worker hosting compiled-DAG
+        stages (raylet.py _handle_worker_death)."""
+        from ray_tpu.dag import compiled_dag
+        compiled_dag.on_peer_down(payload)
+        return {}
 
     async def _h_preemption_notice(self, payload, conn):
         """The raylet is draining (TPU preemption): surface the deadline

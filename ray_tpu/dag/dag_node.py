@@ -53,6 +53,18 @@ class DAGNode:
         InputNode roots)."""
         return self._execute_cached({}, input_value)
 
+    def compile(self, **options) -> "CompiledDAG":
+        """Pre-wire this graph into a :class:`CompiledDAG`: actors
+        created once, peer-to-peer channels opened between consecutive
+        stages, one trigger frame per execution (docs/COMPILED_DAGS.md).
+        Graphs that cannot compile (non-actor stages, multi-upstream
+        nodes, pre-1.5 peers) transparently run the dynamic path."""
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+        return CompiledDAG(self, **options)
+
+    # reference-parity alias (python/ray/dag experimental_compile)
+    experimental_compile = compile
+
 
 class InputNode(DAGNode):
     """Placeholder for the runtime input (reference: input_node.py:343).
@@ -85,16 +97,45 @@ class FunctionNode(DAGNode):
 
 
 class ClassNode(DAGNode):
-    """Lazy actor instantiation; attribute access yields method nodes."""
+    """Lazy actor instantiation; attribute access yields method nodes.
+
+    The actor handle is cached ON THE NODE across executions — a DAG
+    instance owns one actor per ClassNode (the reference's class_node
+    semantics), so repeated ``dag.execute()`` calls reuse the same actor
+    instead of leaking a fresh one per run. Constructor args are
+    resolved on the first execution only."""
 
     def __init__(self, actor_cls, args, kwargs, opts=None):
         super().__init__(args, kwargs)
         self._actor_cls = actor_cls
         self._opts = opts or {}
+        self._cached_actor = None
 
     def _execute_impl(self, cache, input_value):
-        args, kwargs = self._resolve_args(cache, input_value)
-        return self._actor_cls._create(self._opts, args, kwargs)
+        if self._cached_actor is None:
+            args, kwargs = self._resolve_args(cache, input_value)
+            self._cached_actor = self._actor_cls._create(
+                self._opts, args, kwargs)
+        return self._cached_actor
+
+    def _invalidate_actor(self):
+        """Drop the cached handle; the next execution creates a fresh
+        actor (used when the actor died — compiled-DAG fallback)."""
+        self._cached_actor = None
+
+    def _invalidate_if_dead(self):
+        if self._cached_actor is None:
+            return
+        try:
+            from ray_tpu._private.worker import global_worker
+            w = global_worker()
+            info = w.call_sync(w.gcs, "get_actor",
+                               {"actor_id": self._cached_actor._id_hex},
+                               timeout=10)
+            if info.get("error") or info.get("state") == "DEAD":
+                self._cached_actor = None
+        except Exception:
+            self._cached_actor = None
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -128,3 +169,18 @@ class ClassMethodNode(DAGNode):
         actor = self._class_node._execute_cached(cache, input_value)
         args, kwargs = self._resolve_args(cache, input_value)
         return getattr(actor, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Aggregates several output nodes so one graph can fan out to
+    multiple sinks (reference: python/ray/dag MultiOutputNode).
+    ``execute()`` returns the outputs as a list (of ObjectRefs on the
+    dynamic path; of values when compiled)."""
+
+    def __init__(self, outputs):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, cache, input_value):
+        return [a._execute_cached(cache, input_value)
+                if isinstance(a, DAGNode) else a
+                for a in self._bound_args]

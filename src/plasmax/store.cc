@@ -392,6 +392,23 @@ int px_release(void* base, const uint8_t* id) {
   return 0;
 }
 
+// Recycle a sealed buffer for in-place rewrite (compiled-DAG channel rings:
+// the writer creates the slot once, keeps its creator pin, and cycles
+// seal→unseal→refill→seal per invocation — zero allocator churn, so segment
+// usage stays flat across repeated graph executions). Requires exactly the
+// creator's pin outstanding (refcnt==1): a reader mid-get returns -2 and the
+// writer retries. -1 not found / not sealed.
+int px_unseal(void* base, const uint8_t* id, uint64_t* out_off) {
+  Header* h = static_cast<Header*>(base);
+  Locker lk(h);
+  Slot* s = find_slot(base, id);
+  if (!s || s->state != kSealed) return -1;
+  if (s->refcnt != 1) return -2;
+  s->state = kCreated;
+  *out_off = s->offset;
+  return 0;
+}
+
 // Delete a sealed object with no outstanding refs. -1 not found, -2 in use.
 int px_delete(void* base, const uint8_t* id) {
   Header* h = static_cast<Header*>(base);

@@ -29,6 +29,31 @@ def test_frame_layout_golden_vectors():
     assert frame.hex() == "0d000000940101a470696e6781a26f6bc3"
 
 
+def test_dag_channel_frame_golden_vectors():
+    """Compiled-DAG channel frames (1.5; docs/WIRE_PROTOCOL.md §1.5 +
+    docs/COMPILED_DAGS.md). They ride dedicated channel sockets but use
+    the same framing, so a second-language stage implements these exact
+    bytes."""
+    from ray_tpu.dag.channel import pack_dag_frame
+    frame = pack_dag_frame("dag_exec",
+                           {"d": "ab.g1", "t": 0, "s": 1, "b": b"\x01"})
+    assert frame.hex() == (
+        "20000000"
+        "9403c0a8"
+        "6461675f6578656384a164a561622e6731a17400a17301a162c40101")
+    frame = pack_dag_frame("dag_result", {"d": "ab.g1", "s": 1, "i": 0,
+                                          "ae": False, "b": b"\x02"})
+    assert frame.hex() == (
+        "26000000"
+        "9403c0aa6461675f726573756c7485a164a561622e6731"
+        "a17301a16900a26165c2a162c40102")
+    for method in ("dag_channel_open", "dag_channel_close",
+                   "dag_register", "dag_unregister", "dag_stage_error",
+                   "dag_peer_down", "dag_exec", "dag_result"):
+        assert method in schema.SCHEMAS, method
+    assert schema.PROTOCOL_VERSION >= (1, 5)
+
+
 def test_frame_roundtrip_and_length_prefix():
     body = [protocol.REQUEST, 7, "kv_get", {"key": b"\x00\x01"}]
     frame = protocol.pack_frame(body)
