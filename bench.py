@@ -1320,6 +1320,168 @@ def _supervise():
     _emit(0.0, 0.0, error="; ".join(str(e) for e in errors)[:500])
 
 
+def _trace_bench_main():
+    """Tracing bench (_BENCH_TRACE=1): (a) span-pipeline throughput —
+    record_span + flush rates on a discard sender; (b) the overhead
+    gate — serve closed-loop RPS through the handle path with default
+    sampling vs RTPU_TRACING=0, one fresh cluster per mode so replicas
+    inherit the env. Gate (PERF.md): on/off RPS ratio >= 0.95."""
+    _force_cpu_platform()
+    import threading
+
+    import numpy as np
+
+    from ray_tpu._private import tracing
+
+    duration = float(os.environ.get("BENCH_TRACE_DURATION", 3.0))
+    clients = int(os.environ.get("BENCH_TRACE_CLIENTS", 8))
+    service_ms = float(os.environ.get("BENCH_TRACE_SERVICE_MS", 2.0))
+    out = {"duration_s": duration, "clients": clients,
+           "service_ms": service_ms}
+
+    # ---- (a) span pipeline microbench: pure record + flush cost ----
+    # forced sample=1.0 so this measures the RECORDED path, not the
+    # early head-sample drop (the serve section below measures the
+    # default-sampling mix)
+    prev_sample = os.environ.get("RTPU_TRACE_SAMPLE")
+    os.environ["RTPU_TRACE_SAMPLE"] = "1.0"
+    tracing.refresh()
+    tracing.set_sender(lambda p: True)  # count-and-discard
+    try:
+        n = 200_000
+        now = time.time()
+        t0 = time.perf_counter()
+        for i in range(n):
+            tracing.record_span("bench-trace", f"s{i}", "bench",
+                                phase="execute", start_ts=now,
+                                end_ts=now + 0.001)
+        record_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        while tracing.pending_count():
+            tracing.flush()
+        flush_dt = time.perf_counter() - t0
+        out["record_spans_per_s"] = round(n / record_dt)
+        out["record_us_per_span"] = round(record_dt / n * 1e6, 3)
+        out["flush_spans_per_s"] = round(n / max(flush_dt, 1e-9))
+    finally:
+        tracing.set_sender(None)
+        tracing.stop_flusher()
+        if prev_sample is None:
+            os.environ.pop("RTPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["RTPU_TRACE_SAMPLE"] = prev_sample
+        tracing.refresh()
+
+    # ---- (b) serve closed-loop: tracing on vs off ----
+    def closed_loop(fn, n_clients, dur):
+        lat, errors = [], [0]
+        lock = threading.Lock()
+        stop = time.perf_counter() + dur
+
+        def worker():
+            local = []
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                try:
+                    fn()
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                local.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(local)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not lat:
+            return {"rps": 0.0, "p50_ms": 0.0, "p99_ms": 0.0,
+                    "errors": errors[0]}
+        arr = np.asarray(lat)
+        return {"rps": round(len(lat) / dur, 1),
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+                "errors": errors[0]}
+
+    class TraceEcho:
+        def __init__(self, service_s):
+            self.service_s = service_s
+
+        def __call__(self, x):
+            time.sleep(self.service_s)
+            return x
+
+    # Interleaved A/B windows in ONE cluster: per-run RPS drifts ~8% on
+    # the 1-core box, far above the 5% gate, so mode-per-cluster
+    # comparisons measure thermal luck. Tracing is driver-gated
+    # (unsampled/disabled requests carry no trace ctx, so the replica
+    # does zero tracing work), which makes toggling RTPU_TRACING in the
+    # driver between back-to-back windows a fair whole-path comparison.
+    import ray_tpu
+    from ray_tpu import serve
+    prev = os.environ.get("RTPU_TRACING")
+    os.environ.pop("RTPU_TRACING", None)  # replicas: library default
+    tracing.refresh()
+    rounds = int(os.environ.get("BENCH_TRACE_ROUNDS", 3))
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024,
+                 _system_config={"prestart_workers": False})
+    try:
+        from ray_tpu.serve.handle import _reset_router
+        _reset_router()
+        h = serve.run(
+            serve.deployment(num_replicas=2,
+                             max_concurrent_queries=32)(
+                TraceEcho).bind(service_ms / 1e3),
+            name="trace_bench", http_port=None)
+        seq = iter(range(1 << 30))
+
+        def call():
+            import ray_tpu as rt
+            rt.get(h.remote(
+                1, __rtpu_request_id__=f"tb-{next(seq)}"),
+                timeout=30.0)
+
+        for _ in range(16):
+            call()  # warm replicas + router + span path
+        stats = {"off": [], "on": []}
+        for _ in range(rounds):
+            for mode, env in (("off", "0"), ("on", "1")):
+                os.environ["RTPU_TRACING"] = env
+                tracing.refresh()
+                stats[mode].append(closed_loop(call, clients, duration))
+        for mode in ("off", "on"):
+            best = max(s["rps"] for s in stats[mode])
+            out[f"serve_{mode}_rps"] = round(
+                sum(s["rps"] for s in stats[mode]) / rounds, 1)
+            out[f"serve_{mode}_rps_best"] = best
+            out[f"serve_{mode}_p50_ms"] = round(
+                sum(s["p50_ms"] for s in stats[mode]) / rounds, 2)
+            out[f"serve_{mode}_p99_ms"] = round(
+                max(s["p99_ms"] for s in stats[mode]), 2)
+            out[f"serve_{mode}_errors"] = sum(
+                s["errors"] for s in stats[mode])
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+        if prev is None:
+            os.environ.pop("RTPU_TRACING", None)
+        else:
+            os.environ["RTPU_TRACING"] = prev
+        tracing.refresh()
+    if out.get("serve_off_rps"):
+        out["trace_overhead_rps_ratio"] = round(
+            out["serve_on_rps"] / out["serve_off_rps"], 3)
+        out["trace_overhead_ok"] = \
+            out["trace_overhead_rps_ratio"] >= 0.95
+    print(json.dumps({"metric": "tracing", **out}), flush=True)
+
+
 def main():
     if os.environ.get("_BENCH_RAW"):
         try:
@@ -1366,6 +1528,12 @@ def main():
     elif os.environ.get("_BENCH_DAG"):
         try:
             _dag_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_TRACE"):
+        try:
+            _trace_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -117,6 +117,10 @@ class TaskEventTable:
         self.events_dropped = 0   # process-ring overflow (reported in)
         self.state_counts: Dict[str, int] = {}
         self.total_seen = 0       # records ever created
+        # trace_id -> {task_id}: lets get_trace synthesize task-phase
+        # spans without scanning the whole table (bounded by the same
+        # cap — entries die with their records)
+        self.trace_index: Dict[str, set] = {}
 
     def _count(self, state: Optional[str], delta: int):
         if state:
@@ -139,15 +143,22 @@ class TaskEventTable:
         # fields merge regardless of ordering (a late PENDING event
         # still fills in name/job_id it uniquely knows)
         for k in ("name", "job_id", "node_id", "worker_pid",
-                  "trace_ctx"):
+                  "trace_ctx", "dispatch_ts", "deser_s", "ship_s"):
             if ev.get(k) is not None:
                 rec[k] = ev[k]
+        tc = rec.get("trace_ctx")
+        if tc and tc.get("trace_id"):
+            self.trace_index.setdefault(tc["trace_id"], set()).add(tid)
         attempt = int(ev.get("attempt") or 0)
         old_rank = tev.STATE_RANK.get(rec["state"], -1)
         new_rank = tev.STATE_RANK[state]
         if attempt > rec["attempt"]:
-            # a retry restarts the lifecycle: state may regress
+            # a retry restarts the lifecycle: state may regress (and
+            # the previous attempt's phase timestamps no longer
+            # describe this lifecycle)
             rec["attempt"] = attempt
+            rec.pop("state_ts", None)
+            rec.pop("dispatch_ts", None)
             advance = True
         elif attempt < rec["attempt"]:
             # stale attempt (flush ticks race across processes): its
@@ -159,6 +170,16 @@ class TaskEventTable:
             self._count(rec["state"], -1)
             self._count(state, +1)
             rec["state"] = state
+        if attempt == rec["attempt"] and ev.get("ts") is not None:
+            # per-state wall clock: what get_trace synthesizes the
+            # task's queue/schedule/dispatch/execute spans from.
+            # Recorded REGARDLESS of advance — flush ticks from
+            # different processes race, so a worker's FINISHED often
+            # lands before the raylet's queue stamp; each state's ts is
+            # a fact of this attempt, not a merge-ordering outcome
+            # (first event per state wins: the raylet re-emits its
+            # state at dispatch time to carry dispatch_ts).
+            rec.setdefault("state_ts", {}).setdefault(state, ev["ts"])
         if advance:
             if state == tev.RUNNING:
                 rec["start_ts"] = ev.get("ts")
@@ -189,6 +210,12 @@ class TaskEventTable:
             if rec is not None:
                 self._count(rec["state"], -1)
                 self.dropped += 1
+                tc = rec.get("trace_ctx") or {}
+                tids = self.trace_index.get(tc.get("trace_id"))
+                if tids is not None:
+                    tids.discard(victim)
+                    if not tids:
+                        self.trace_index.pop(tc["trace_id"], None)
 
     def summary(self) -> Dict[str, Any]:
         return {"total": len(self.records),
@@ -197,6 +224,101 @@ class TaskEventTable:
                 "dropped": self.dropped,
                 "events_dropped": self.events_dropped,
                 "cap": self.cap}
+
+
+class TraceTable:
+    """Bounded, indexed span store fed by the ``trace_spans`` pipeline
+    (the TaskEventTable contract applied to traces: a hard span cap,
+    oldest-updated trace evicted first, a visible drop counter instead
+    of silent loss or OOM).
+
+    Spans group by trace_id; insertion order of the ``traces`` dict is
+    maintained as LRU-by-last-update so eviction is O(1) amortized.
+    """
+
+    def __init__(self, cap: Optional[int] = None,
+                 per_trace_cap: Optional[int] = None):
+        if cap is None:
+            cap = int(os.environ.get("RTPU_TRACE_TABLE_MAX", 65536))
+        if per_trace_cap is None:
+            per_trace_cap = int(os.environ.get(
+                "RTPU_TRACE_MAX_SPANS", 512))
+        self.cap = max(1, int(cap))
+        self.per_trace_cap = max(1, int(per_trace_cap))
+        # trace_id -> {"spans": [...], "updated_ts", "start_ts",
+        # "end_ts", "root_name", "error"}  (dict preserves insertion
+        # order; re-insert on update = LRU)
+        self.traces: Dict[str, Dict[str, Any]] = {}
+        self.total_spans = 0
+        self.dropped_spans = 0      # evicted/over-cap spans
+        self.spans_dropped_rings = 0  # process-ring overflow (reported)
+        self.total_seen = 0
+
+    def apply(self, span: Dict[str, Any]):
+        tid = span.get("trace_id")
+        if not tid or span.get("span_id") is None:
+            return
+        self.total_seen += 1
+        ent = self.traces.pop(tid, None)
+        if ent is None:
+            ent = {"spans": [], "start_ts": span.get("start_ts"),
+                   "end_ts": span.get("end_ts"), "root_name": None,
+                   "error": False}
+        self.traces[tid] = ent  # re-insert: newest at the end
+        ent["updated_ts"] = time.time()
+        if len(ent["spans"]) >= self.per_trace_cap:
+            self.dropped_spans += 1
+            return
+        ent["spans"].append(span)
+        self.total_spans += 1
+        ts0, ts1 = span.get("start_ts"), span.get("end_ts")
+        if ts0 is not None and (ent["start_ts"] is None
+                                or ts0 < ent["start_ts"]):
+            ent["start_ts"] = ts0
+        if ts1 is not None and (ent["end_ts"] is None
+                                or ts1 > ent["end_ts"]):
+            ent["end_ts"] = ts1
+        if span.get("status") == "error":
+            ent["error"] = True
+        if span.get("parent_span_id") in (None, "", "root"):
+            ent["root_name"] = span.get("name")
+        self._evict()
+
+    def _evict(self):
+        while self.total_spans > self.cap and len(self.traces) > 1:
+            victim_id = next(iter(self.traces))  # oldest-updated
+            victim = self.traces.pop(victim_id)
+            self.total_spans -= len(victim["spans"])
+            self.dropped_spans += len(victim["spans"])
+
+    def get(self, trace_id: str) -> List[Dict[str, Any]]:
+        ent = self.traces.get(trace_id)
+        return list(ent["spans"]) if ent else []
+
+    def summary_rows(self) -> List[Dict[str, Any]]:
+        rows = []
+        for tid, ent in self.traces.items():
+            t0, t1 = ent.get("start_ts"), ent.get("end_ts")
+            rows.append({
+                "trace_id": tid,
+                "root": ent.get("root_name"),
+                "spans": len(ent["spans"]),
+                "start_ts": t0,
+                "duration_s": (round(t1 - t0, 6)
+                               if t0 is not None and t1 is not None
+                               else None),
+                "status": "error" if ent.get("error") else "ok",
+            })
+        return rows
+
+    def summary(self) -> Dict[str, Any]:
+        return {"traces": len(self.traces),
+                "spans": self.total_spans,
+                "total_seen": self.total_seen,
+                "dropped_spans": self.dropped_spans,
+                "spans_dropped_rings": self.spans_dropped_rings,
+                "cap": self.cap}
+
 
 # Actor states (reference: design_docs/actor_states.rst)
 DEPS_UNREADY = "DEPENDENCIES_UNREADY"
@@ -261,6 +383,10 @@ class GcsServer:
         # bounded task table fed by the task-event pipeline (reference:
         # gcs_task_manager.cc); cap via RTPU_TASK_TABLE_MAX
         self.task_table = TaskEventTable()
+        # bounded span store fed by the trace-span pipeline (cap via
+        # RTPU_TRACE_TABLE_MAX); get_trace merges it with task-phase
+        # spans synthesized from the task table's per-state timestamps
+        self.trace_table = TraceTable()
         # scheduler's pessimistic view of its own in-flight placements:
         # node_id -> [(expiry, demand)] (see _utilization)
         self._ephemeral_allocs: Dict[str, List[Tuple[float, Dict[str,
@@ -309,6 +435,9 @@ class GcsServer:
             "add_event": self.add_event,
             "list_events": self.list_events,
             "task_events": self.task_events,
+            "trace_spans": self.trace_spans,
+            "get_trace": self.get_trace,
+            "list_traces": self.list_traces,
             "list_tasks": self.list_tasks,
             "list_objects": self.list_objects,
             "summarize": self.summarize,
@@ -471,6 +600,69 @@ class GcsServer:
                              exc_info=True)
         return {}
 
+    async def trace_spans(self, payload, conn):
+        """Batched spans from the per-process tracing buffers — folded
+        into the bounded trace table (never stored raw)."""
+        payload = payload or {}
+        self.trace_table.spans_dropped_rings += \
+            int(payload.get("dropped") or 0)
+        for span in payload.get("spans") or ():
+            try:
+                self.trace_table.apply(span)
+            except Exception:
+                logger.debug("bad span dropped: %r", span, exc_info=True)
+        return {}
+
+    async def get_trace(self, payload, conn):
+        """One trace's full span set: explicit spans (serve, dag hops,
+        object pulls) merged with task-lifecycle spans synthesized from
+        the state engine's task records — assembled HERE, where both
+        tables live, in one RPC."""
+        from ray_tpu._private import tracing
+        trace_id = (payload or {}).get("trace_id") or ""
+        spans = self.trace_table.get(trace_id)
+        for task_id in sorted(
+                self.task_table.trace_index.get(trace_id) or ()):
+            rec = self.task_table.records.get(task_id)
+            if rec is not None:
+                spans.extend(tracing.synthesize_task_spans(rec))
+        return {"trace_id": trace_id, "spans": spans,
+                **{k: v for k, v in self.trace_table.summary().items()
+                   if k in ("dropped_spans", "spans_dropped_rings")}}
+
+    async def list_traces(self, payload, conn):
+        """Cursor-paginated trace summaries: explicit-span traces plus
+        task-only traces (a task tree whose trace never recorded an
+        explicit span is still browsable)."""
+        rows = self.trace_table.summary_rows()
+        seen = {r["trace_id"] for r in rows}
+        for tid, task_ids in self.task_table.trace_index.items():
+            if tid in seen:
+                continue
+            recs = [self.task_table.records[t] for t in task_ids
+                    if t in self.task_table.records]
+            if not recs:
+                continue
+            starts = [r.get("created_ts") for r in recs
+                      if r.get("created_ts") is not None]
+            ends = [r.get("end_ts") for r in recs
+                    if r.get("end_ts") is not None]
+            rows.append({
+                "trace_id": tid,
+                "root": min(recs, key=lambda r: r.get("created_ts")
+                            or 0).get("name"),
+                "spans": len(recs),
+                "start_ts": min(starts) if starts else None,
+                "duration_s": (round(max(ends) - min(starts), 6)
+                               if starts and ends else None),
+                "status": ("error" if any(r.get("state") == "FAILED"
+                                          for r in recs) else "ok"),
+            })
+        reply = paginate(rows, payload, "trace_id")
+        if isinstance(reply, dict):
+            reply["dropped"] = self.trace_table.dropped_spans
+        return reply
+
     async def list_tasks(self, payload, conn):
         rows = [dict(r) for r in self.task_table.records.values()]
         reply = paginate(rows, payload, "task_id")
@@ -544,6 +736,7 @@ class GcsServer:
             "available_resources": await self.available_resources({},
                                                                   conn),
             "tasks": self.task_table.summary(),
+            "traces": self.trace_table.summary(),
         }
 
     async def summarize_tasks(self, payload, conn):
@@ -579,7 +772,12 @@ class GcsServer:
         if cap is not None:
             self.task_table.cap = max(1, int(cap))
             self.task_table._evict()
-        return {"task_table_max": self.task_table.cap}
+        tcap = (payload or {}).get("trace_table_max")
+        if tcap is not None:
+            self.trace_table.cap = max(1, int(tcap))
+            self.trace_table._evict()
+        return {"task_table_max": self.task_table.cap,
+                "trace_table_max": self.trace_table.cap}
 
     async def _health_loop(self):
         period = self.config.health_check_period_s

@@ -282,11 +282,14 @@ class Server:
             # incompatible majors so drift fails at connect, not mid-RPC
             from ray_tpu._private import schema
             ver = (payload or {}).get("protocol_version")
-            if isinstance(ver, (list, tuple)) and len(ver) == 2:
+            if conn is not None and isinstance(ver, (list, tuple)) \
+                    and len(ver) == 2:
                 try:
                     # remember what the peer negotiated: handlers gate
                     # minor-version features (e.g. batched dispatch
-                    # statuses) on this instead of assuming the newest
+                    # statuses) on this instead of assuming the newest.
+                    # conn is None for in-process dispatch (tests);
+                    # there is no peer to remember then
                     conn.meta["peer_protocol_version"] = (
                         int(ver[0]), int(ver[1]))
                 except (TypeError, ValueError):

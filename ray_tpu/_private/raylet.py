@@ -1067,6 +1067,12 @@ class Raylet:
         handle.job_id = ptask.spec.get("job_id") or handle.job_id
         handle.num_tasks += 1
         self._tasks_dispatched_total += 1
+        # worker picked: closes the "schedule" phase of the synthesized
+        # task trace (queue->schedule->dispatch->execute); the state
+        # machine doesn't advance — this event only carries the stamp
+        tev.emit(ptask.spec.get("task_id"), tev.PENDING_NODE_ASSIGNMENT,
+                 node_id=self.node_id, attempt=ptask.spec.get("attempt"),
+                 dispatch_ts=time.time())
         # chaos injection point: process faults keyed on dispatch count
         # (kill the dispatched-to worker, kill this raylet, or deliver a
         # preemption notice at the N-th task)

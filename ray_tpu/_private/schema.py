@@ -50,7 +50,13 @@ from typing import Any, Dict, List, Optional, Tuple
 #      on the peer having negotiated >= 1.5 via __hello__ (a legacy peer
 #      degrades the whole graph to dynamic dispatch — docs/
 #      COMPILED_DAGS.md).
-PROTOCOL_VERSION = (1, 5)
+# 1.6: distributed tracing — trace_spans batches + get_trace/list_traces
+#      GCS methods, trace_ctx on actor_call, the optional "tc" trace
+#      context on dag_exec/dag_result channel frames (only sent when
+#      every stage peer negotiated >= 1.6 via __hello__ — a legacy peer
+#      runs the graph untraced, never broken), trace_table_max on
+#      configure_state — docs/TRACING.md.
+PROTOCOL_VERSION = (1, 6)
 
 _str = str
 _num = numbers.Number
@@ -205,6 +211,9 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "seq": (_int, False),
         "processed_up_to": (_int, False),
         "caller": (_str, False),
+        # 1.6: the caller's span context — tasks submitted from inside
+        # the method parent under the call instead of a fresh root
+        "trace_ctx": (_dict, False),
     },
     # ---- compiled-DAG channels (1.5; docs/COMPILED_DAGS.md). The
     # control-plane trio (open/close/register) rides the normal RPC
@@ -231,10 +240,14 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
                       "worker_id": (_str, False)},
     "dag_exec": {"d": (_str, True), "t": (_int, True), "s": (_int, True),
                  "b": (_bytes, False), "o": (_str, False),
-                 "n": (_int, False)},
+                 "n": (_int, False),
+                 # 1.6: {"trace_id","span_id"} — stages record hop
+                 # spans chained under it; absent on pre-1.6 graphs
+                 "tc": (_dict, False)},
     "dag_result": {"d": (_str, True), "s": (_int, True), "i": (_int, True),
                    "ae": (_bool, False), "b": (_bytes, False),
-                   "o": (_str, False), "n": (_int, False)},
+                   "o": (_str, False), "n": (_int, False),
+                   "tc": (_dict, False)},
     # ---- worker lifecycle (the second-language worker surface —
     # docs/WIRE_PROTOCOL.md declares this table normative for it)
     "worker_register": {"worker_id": (_str, True),
@@ -265,7 +278,14 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
                      "node_id": (_any, False)},
     "summarize": {},
     "summarize_tasks": {},
-    "configure_state": {"task_table_max": (_int, False)},
+    "configure_state": {"task_table_max": (_int, False),
+                        "trace_table_max": (_int, False)},
+    # ---- distributed tracing (1.6; docs/TRACING.md)
+    "trace_spans": {"spans": (_list, True), "dropped": (_int, False)},
+    "get_trace": {"trace_id": (_str, True)},
+    "list_traces": {"paged": (_bool, False), "limit": (_int, False),
+                    "continuation_token": (_any, False),
+                    "filters": (_dict, False)},
 }
 
 

@@ -74,7 +74,11 @@ def emit(task_id: str, state: str, **fields) -> None:
     """Record one lifecycle transition. O(1); never blocks on I/O.
 
     ``fields``: name, job_id, node_id, worker_pid, attempt, error,
-    trace_ctx — only non-None values ride the wire.
+    trace_ctx, plus the tracing-plane stamps the GCS synthesizes task
+    phase spans from (docs/TRACING.md): ``dispatch_ts`` (raylet, at
+    worker handoff), ``deser_s`` / ``ship_s`` (worker, arg
+    deserialization and return shipping). Only non-None values ride
+    the wire.
     """
     if not task_id:
         return

@@ -550,9 +550,24 @@ class Worker:
             self.reference_counter.drain_deferred()
         except Exception:
             pass
-        # ship the last task-event batch while the GCS link still lives
+        # ship the last task-event + trace-span batches while the GCS
+        # link still lives, then stop the background flusher threads —
+        # _flusher_started flags never reset, so without the stop every
+        # init/shutdown cycle (tests reconnect dozens of times) leaked
+        # one timeline/tracing thread per cycle
         try:
             tev.flush_all(timeout=1.0)
+        except Exception:
+            pass
+        try:
+            from ray_tpu._private import tracing
+            tracing.flush_all(timeout=1.0)
+            tracing.stop_flusher()
+        except Exception:
+            pass
+        try:
+            from ray_tpu.util import timeline
+            timeline.stop_flusher()
         except Exception:
             pass
         self.connected = False
@@ -1038,7 +1053,25 @@ class Worker:
             return
         if self.raylet is None:
             raise exc.ObjectLostError(oid, "no raylet to fetch through")
-        self.call_sync(self.raylet, "fetch_object", {"object_id": oid.hex()})
+        # object-plane transfer span: a cross-node pull is the slow path
+        # (chunked raylet↔raylet copy), exactly what latency attribution
+        # must see; local hits returned above without touching tracing
+        from ray_tpu._private import tracing
+        cur = self._current_trace() if tracing.enabled() else None
+        sp = tracing.span_if(cur and cur.get("trace_id"),
+                             f"object.pull:{oid.hex()[:12]}",
+                             parent_span_id=cur and cur.get("span_id"),
+                             kind="object.pull", phase="transfer",
+                             attrs={"object_id": oid.hex()})
+        try:
+            self.call_sync(self.raylet, "fetch_object",
+                           {"object_id": oid.hex()})
+        except BaseException:
+            if sp is not None:
+                sp.finish("error")
+            raise
+        if sp is not None:
+            sp.finish()
 
     def _maybe_reconstruct(self, oid: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the creating task (reference:
@@ -1879,14 +1912,20 @@ class Worker:
         # adopt the propagated span: child submits from inside this task
         # will parent to it
         self.task_context.trace = spec.get("trace_ctx")
+        _deser_s = _ship_t0 = None
         try:
             if task_hex in self._cancelled_tasks:
                 raise exc.TaskCancelledError(task_hex)
             fn = self.function_manager.fetch(spec["fn_key"])
+            _td0 = time.time()
             args, kwargs = serialization.deserialize(spec["args"])
             args = [self._resolve_arg(a) for a in args]
             kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            # arg deserialization + dependency resolution: the
+            # "deserialize" phase of the synthesized task trace
+            _deser_s = round(time.time() - _td0, 6)
             result = fn(*args, **kwargs)
+            _ship_t0 = time.time()  # result shipping = "transfer" phase
             num_returns = spec["num_returns"]
             if num_returns == 1:
                 values = [result]
@@ -1925,7 +1964,10 @@ class Worker:
                      tev.FAILED if app_error else tev.FINISHED,
                      name=spec.get("fn_name"), job_id=spec.get("job_id"),
                      node_id=self.node_id, worker_pid=os.getpid(),
-                     attempt=spec.get("attempt"), error=_task_err)
+                     attempt=spec.get("attempt"), error=_task_err,
+                     deser_s=_deser_s,
+                     ship_s=(round(time.time() - _ship_t0, 6)
+                             if _ship_t0 is not None else None))
         if reply is not None:
             # leased task: the RPC reply carries the result (no owner
             # notify, no task_done — the lease holds the resources)
@@ -2145,7 +2187,17 @@ class Worker:
             seq = TaskID(bytes.fromhex(payload["task_id"]))
             if emit_tev:
                 tev.emit(payload["task_id"], tev.RUNNING, name=fn_label,
-                         node_id=self.node_id, worker_pid=os.getpid())
+                         node_id=self.node_id, worker_pid=os.getpid(),
+                         trace_ctx=payload.get("trace_ctx"))
+            # adopt the caller's propagated span (nested-parent fix):
+            # without this, a task submitted from inside an actor
+            # method — including every serve replica's user code —
+            # parented to this worker's _root_trace instead of its
+            # caller, severing the trace tree at the actor boundary.
+            # Saved/restored, not cleared: actor executor threads are
+            # pooled and a replica may have installed a serve span.
+            prev_trace = getattr(self.task_context, "trace", None)
+            self.task_context.trace = payload.get("trace_ctx")
             try:
                 args, kwargs = serialization.deserialize(payload["args"])
                 args = [self._resolve_arg(a) for a in args]
@@ -2172,6 +2224,8 @@ class Worker:
                 oid = ObjectID.for_return(seq, 0)
                 return {"object_id": oid.hex(), "inline": ser.to_bytes(),
                         "app_error": True}
+            finally:
+                self.task_context.trace = prev_trace
 
         try:
             executor = self._executor_for(method)

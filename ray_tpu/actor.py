@@ -106,6 +106,10 @@ class ActorHandle:
             "args": arg_blob,
             # "seq"/"processed_up_to" are stamped at enqueue time below
             "caller": w.address,
+            # span propagation (1.6): the executing actor adopts this
+            # ctx so tasks it submits parent under the call, not under
+            # the actor worker's own root trace
+            "trace_ctx": w._trace_ctx_for_submit(),
         }
         oid = ObjectID.for_return(task_id, 0)
         state = PendingTaskState({"task_id": task_id.hex(),

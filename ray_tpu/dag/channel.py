@@ -38,9 +38,10 @@ import logging
 import os
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu._private import chaos, protocol, serialization
+from ray_tpu._private import chaos, protocol, serialization, tracing
 from ray_tpu.common.ids import ObjectID
 
 logger = logging.getLogger(__name__)
@@ -348,16 +349,39 @@ class StageRuntime:
             # the N-th execution of one mid-graph stage (the generic
             # dag.channel site can't tell stages apart)
             chaos.hit("dag.stage", str(self.stage_id))
+        # hop span (1.6): frames from a >=1.6 driver carry "tc"; this
+        # stage's span chains under the upstream hop (or the execute
+        # root) and its own ctx rides the forwarded frame — the trace
+        # tree follows the data through the pipe. Legacy frames have no
+        # "tc" and the graph runs untraced.
+        tc = payload.get("tc")
+        span = None
+        if tc and tracing.enabled():
+            span = tracing.Span(
+                tc["trace_id"],
+                f"dag.stage:{self.method.__name__}",
+                parent_span_id=tc.get("span_id"), kind="dag.hop",
+                phase="execute",
+                attrs={"dag_id": self.dag_id,
+                       "stage_id": self.stage_id, "seq": seq})
+        fwd_tc = span.child_ctx() if span is not None else None
         try:
             value = decode_value(self.worker.plasma, payload)
         except BaseException as e:  # noqa: BLE001 — upstream app error
             # an upstream stage error travels the pipe as an error
             # envelope; terminal stages surface it to the driver, middle
             # stages just pass it on without running user code
-            self._forward_error(seq, e)
+            self._forward_error(seq, e, tc=fwd_tc)
+            if span is not None:
+                span.finish("error")
             return
         args = [value if t[0] in ("in", "up") else t[1]
                 for t in self.args_tpl]
+        prev_trace = None
+        if span is not None:
+            # nested submits from stage user code parent under this hop
+            prev_trace = getattr(self.worker.task_context, "trace", None)
+            self.worker.task_context.trace = span.trace_ctx()
         try:
             result = self.method(*args, **self.kwargs)
         except BaseException as e:  # noqa: BLE001 — user code
@@ -365,19 +389,38 @@ class StageRuntime:
             err = exc.ActorError.capture(
                 f"{type(self.worker._actor_instance).__name__}."
                 f"{self.method.__name__}", e)
-            self._forward_error(seq, err)
+            self._forward_error(seq, err, tc=fwd_tc)
+            if span is not None:
+                span.finish("error")
             return
+        finally:
+            if span is not None:
+                self.worker.task_context.trace = prev_trace
         ser = serialization.serialize(result)
         desc = encode_value(ser, self.ring, self.inline_max)
-        self._forward(seq, desc, app_error=False)
+        t_fwd = time.time()
+        self._forward(seq, desc, app_error=False, tc=fwd_tc)
+        if span is not None:
+            end = time.time()
+            if end - t_fwd > 1e-4:
+                tracing.record_span(
+                    span.trace_id, tracing.new_span_id(),
+                    f"dag.forward:{self.stage_id}",
+                    parent_span_id=span.span_id, kind="dag.hop",
+                    phase="transfer", start_ts=t_fwd, end_ts=end)
+            span.finish(end_ts=end)
 
-    def _forward_error(self, seq: int, e: BaseException):
+    def _forward_error(self, seq: int, e: BaseException,
+                       tc: Optional[Dict[str, str]] = None):
         ser = serialization.serialize_error(e)
-        self._forward(seq, {"b": ser.to_bytes()}, app_error=True)
+        self._forward(seq, {"b": ser.to_bytes()}, app_error=True, tc=tc)
 
-    def _forward(self, seq: int, desc: Dict[str, Any], app_error: bool):
+    def _forward(self, seq: int, desc: Dict[str, Any], app_error: bool,
+                 tc: Optional[Dict[str, str]] = None):
         for peer in self.downstream:
             frame = {"d": self.dag_id, "s": seq, **desc}
+            if tc is not None:
+                frame["tc"] = tc
             try:
                 if peer["sink"]:
                     peer["sock"].send(DAG_RESULT,

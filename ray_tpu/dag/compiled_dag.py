@@ -32,9 +32,9 @@ import os
 import threading
 import weakref
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import protocol, serialization, tracing
 from ray_tpu._private.worker import global_worker
 from ray_tpu.dag import channel as dagch
 from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
@@ -75,7 +75,7 @@ class _Invocation:
     """Driver-side state of one in-flight compiled execution."""
 
     __slots__ = ("event", "values", "error", "failed", "n_outputs",
-                 "lock", "done", "_cb")
+                 "lock", "done", "_cb", "trace_span")
 
     def __init__(self, n_outputs: int):
         self.event = threading.Event()
@@ -86,6 +86,7 @@ class _Invocation:
         self.lock = threading.Lock()
         self.done = False
         self._cb = None
+        self.trace_span = None  # root span of this execution (1.6)
 
     # channel thread: decode one terminal output and maybe complete
     def deliver(self, index: int, payload: Dict[str, Any], plasma):
@@ -222,6 +223,7 @@ class CompiledDAG:
         # concurrency at the ring depth makes reuse race-free
         self._window = threading.BoundedSemaphore(self._ring_slots)
         self._compile_fail_at = 0.0
+        self._trace_peers = False  # every stage peer negotiated >= 1.6
         try:
             self._analyze()
         except CompileError as e:
@@ -330,6 +332,7 @@ class CompiledDAG:
 
         ep = dagch.get_endpoint(w)
         opened: List[_Stage] = []
+        min_peer: Optional[Tuple[int, int]] = None
         try:
             # open downstream-first so each stage learns its consumers'
             # channel addresses at open time
@@ -356,7 +359,9 @@ class CompiledDAG:
                              "slot_bytes": self._buffer_size},
                 }
                 conn = w.io.run(w._peer(s.address))
-                self._negotiate(w, conn, s.address)
+                ver = self._negotiate(w, conn, s.address)
+                if min_peer is None or tuple(ver) < min_peer:
+                    min_peer = tuple(ver)
                 try:
                     r = w.call_sync(conn, "dag_channel_open", payload,
                                     timeout=30)
@@ -378,13 +383,20 @@ class CompiledDAG:
                 self._close_stage(w, s)
             raise CompileError(f"{type(e).__name__}: {e}")
         _REGISTRY[self.dag_id] = weakref.ref(self)
+        # trace contexts on trigger/forward frames are 1.6 fields:
+        # only send them when EVERY stage peer negotiated >= 1.6 — a
+        # legacy stage runs the graph untraced instead of choking on a
+        # frame shape it never declared (docs/TRACING.md)
+        self._trace_peers = min_peer is not None and min_peer >= (1, 6)
         self._compiled = True
 
     @staticmethod
-    def _negotiate(w, conn, address: str):
+    def _negotiate(w, conn, address: str) -> Tuple[int, int]:
         """Version-gate the channel open (the PR-4 pattern: features ride
         the peer's declared minor). A pre-1.5 peer cannot host a dag
-        stage — degrade to dynamic instead of failing mid-graph."""
+        stage — degrade to dynamic instead of failing mid-graph.
+        Returns the peer's negotiated version (feature gates above 1.5
+        — the 1.6 trace contexts — key off it)."""
         ver = conn.meta.get("peer_protocol_version")
         if ver is None:
             from ray_tpu._private import schema
@@ -403,6 +415,7 @@ class CompiledDAG:
                 f"{ver[0]}.{ver[1]} < "
                 f"{_MIN_PEER_VERSION[0]}.{_MIN_PEER_VERSION[1]} — "
                 "compiled channels need 1.5")
+        return tuple(ver)
 
     @staticmethod
     def _args_template(node: ClassMethodNode) -> List[List[Any]]:
@@ -498,14 +511,31 @@ class CompiledDAG:
         w = global_worker()
         ep = dagch.get_endpoint(w)
         inv = _Invocation(n_outputs=len(self._outputs))
+        tc = None
+        cur = w._current_trace() if self._trace_peers \
+            and tracing.enabled() else None
+        if cur is not None and tracing.sampled(cur["trace_id"]):
+            # root span of this execution, parented under the caller's
+            # current trace (a dag executed inside a task/serve request
+            # nests there); stages chain hop spans off the "tc" field.
+            # Head-sampled out ⇒ no tc ⇒ stages do zero tracing work.
+            inv.trace_span = tracing.Span(
+                cur["trace_id"], f"dag.execute:{self.dag_id[:12]}",
+                parent_span_id=(None if cur.get("span_id") == "root"
+                                else cur.get("span_id")),
+                kind="dag.execute", phase="transfer",
+                attrs={"dag_id": self.dag_id, "seq": seq})
+            tc = inv.trace_span.child_ctx()
         ep.inbox[(dag_id, seq)] = inv
         try:
             blob = serialization.serialize(input_value).to_bytes()
             for s in self._stages:
                 if s.upstream is None:
-                    s.trigger.send(dagch.DAG_EXEC,
-                                   {"d": dag_id, "t": s.stage_id,
-                                    "s": seq, "b": blob})
+                    frame = {"d": dag_id, "t": s.stage_id,
+                             "s": seq, "b": blob}
+                    if tc is not None:
+                        frame["tc"] = tc
+                    s.trigger.send(dagch.DAG_EXEC, frame)
         except Exception as e:  # noqa: BLE001 — send failure = channel down
             inv.fail(f"trigger send failed: {e}")
         return dag_id, seq, inv
@@ -522,6 +552,10 @@ class CompiledDAG:
                 ep.inbox.pop((dag_id, seq), None)
             if not inv.done:
                 inv.fail("execute timed out")  # no-op if just delivered
+            if inv.trace_span is not None:
+                inv.trace_span.finish(
+                    "error" if inv.error is not None
+                    or inv.failed is not None else "ok")
             if inv.error is not None:
                 raise inv.error
             if inv.failed is not None:

@@ -10,7 +10,11 @@ registry) and modules/{node,actor,job,metrics,healthz}. Endpoints:
                              filters, limit + continuation token)
   GET  /api/objects          cluster object listing (per-raylet index)
   GET  /api/summary/tasks    per-function task aggregation
-  GET  /api/timeline         merged chrome-trace task timeline
+  GET  /api/timeline         merged chrome-trace task timeline (+ ring
+                             drop counter)
+  GET  /api/traces           paginated trace summaries
+  GET  /api/trace/<id>       one trace: span tree + critical-path
+                             phase attribution + completeness verdict
   GET  /api/serve/metrics    live serve panel (queue/shed/p99)
   GET  /api/gameday          last game-day SLO report (client-side
                              p50/p99/p99.9, ledger counts, budget
@@ -164,8 +168,33 @@ class DashboardActor:
                 if path == "/api/summary/tasks":
                     return self._json(200, state.summarize_tasks())
                 if path == "/api/timeline":
-                    from ray_tpu.util.timeline import timeline_dump
-                    return self._json(200, {"events": timeline_dump()})
+                    from ray_tpu.util.timeline import (dump_dropped_total,
+                                                       timeline_dump)
+                    evs = timeline_dump()
+                    return self._json(200, {
+                        "events": evs,
+                        "dropped": dump_dropped_total(evs)})
+                if path == "/api/traces":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    page = state.list_traces(
+                        page_size=int((q.get("limit") or ["100"])[0]),
+                        continuation_token=(q.get("token")
+                                            or [None])[0])
+                    return self._json(200, {
+                        "traces": list(page),
+                        "next_token": page.next_token,
+                        "total": page.total,
+                        "dropped": page.dropped})
+                m = re.match(r"^/api/trace/([^/]+)$", path)
+                if m:
+                    from ray_tpu._private import tracing
+                    doc = state.get_trace(m.group(1))
+                    spans = doc.get("spans") or []
+                    doc["critical_path"] = tracing.critical_path(spans)
+                    ok, detail = tracing.tree_complete(spans)
+                    doc["complete"], doc["complete_detail"] = ok, detail
+                    return self._json(200, doc)
                 if path == "/api/serve/metrics":
                     from ray_tpu import serve as _serve
                     return self._json(200,

@@ -91,6 +91,18 @@ INDEX_HTML = """<!doctype html>
     <span class="muted" id="tl-meta"></span>
     <div id="timeline"></div>
   </section>
+  <section>
+    <h2>Traces</h2>
+    <div style="margin-bottom:6px">
+      <button id="tr-load">load traces</button>
+      <input id="tr-id" placeholder="trace id" size="20">
+      <button id="tr-show">waterfall</button>
+      <span class="muted" id="tr-meta"></span>
+    </div>
+    <table id="traces"></table>
+    <div class="muted" id="tr-cp"></div>
+    <div id="waterfall"></div>
+  </section>
   <section><h2>Actors</h2><table id="actors"></table></section>
   <section><h2>Jobs</h2><table id="jobs"></table></section>
   <section><h2>Events</h2><table id="events"></table></section>
@@ -282,6 +294,83 @@ async function loadTimeline() {
   } catch (e) { box.innerHTML = "error: " + esc(e); }
 }
 document.getElementById("tl-load").onclick = loadTimeline;
+
+// Distributed traces: summaries table + per-trace waterfall (one row
+// per span, indented by tree depth, colored by attributed phase) with
+// the critical-path phase table from /api/trace/<id>.
+const PHASE_COLORS = {queue: "#b26a00", schedule: "#6a1b9a",
+  dispatch: "#00838f", transfer: "#546e7a", execute: "#1565c0",
+  deserialize: "#2e7d32", submit: "#9e9d24", other: "#757575"};
+async function loadTraces() {
+  const meta = document.getElementById("tr-meta");
+  try {
+    const d = await get("/api/traces?limit=50");
+    const traces = (d.traces || []).sort(
+      (a, b) => (b.start_ts || 0) - (a.start_ts || 0));
+    meta.textContent = `${traces.length} of ${d.total ?? "?"} shown` +
+      (d.dropped ? ` · ${d.dropped} spans evicted` : "");
+    document.getElementById("traces").innerHTML =
+      head(["trace", "root", "spans", "start", "duration", "status"]) +
+      traces.map(t => row([t.trace_id, t.root || "-", t.spans,
+        t.start_ts ? new Date(t.start_ts * 1000).toLocaleTimeString()
+                   : "-",
+        t.duration_s != null ? ms(t.duration_s) : "-",
+        {v: t.status, cls: t.status === "error" ? "st-FAILED" : ""}
+      ])).join("");
+    document.getElementById("traces").onclick = e => {
+      const tr = e.target.closest("tr");
+      if (tr && tr.cells.length && tr.cells[0].textContent !== "trace") {
+        document.getElementById("tr-id").value =
+          tr.cells[0].textContent;
+        showWaterfall();
+      }
+    };
+  } catch (e) { meta.textContent = "error: " + e; }
+}
+async function showWaterfall() {
+  const id = document.getElementById("tr-id").value.trim();
+  const box = document.getElementById("waterfall");
+  const cpBox = document.getElementById("tr-cp");
+  if (!id) { box.innerHTML = "(enter a trace id)"; return; }
+  box.innerHTML = "loading…";
+  try {
+    const doc = await get("/api/trace/" + encodeURIComponent(id));
+    const spans = (doc.spans || []).filter(
+      s => s.start_ts != null && s.end_ts != null);
+    if (!spans.length) { box.innerHTML = "(no spans)"; return; }
+    const cp = doc.critical_path || {};
+    cpBox.textContent = `critical path: ` +
+      Object.entries(cp.phases || {}).map(([k, v]) =>
+        `${k} ${(v * 1e3).toFixed(1)}ms`).join(" · ") +
+      ` — ${((cp.attributed_frac || 0) * 100).toFixed(1)}% attributed` +
+      (doc.complete ? "" : ` · INCOMPLETE: ${doc.complete_detail}`);
+    const ids = new Set(spans.map(s => s.span_id));
+    const depth = s => { let d = 0, cur = s;
+      const byId = Object.fromEntries(spans.map(x => [x.span_id, x]));
+      while (cur && ids.has(cur.parent_span_id) && d < 32) {
+        cur = byId[cur.parent_span_id]; d++; } return d; };
+    const t0 = Math.min(...spans.map(s => s.start_ts));
+    const t1 = Math.max(...spans.map(s => s.end_ts));
+    const span = Math.max(t1 - t0, 1e-6);
+    box.innerHTML = spans.slice().sort((a, b) =>
+      a.start_ts - b.start_ts || depth(a) - depth(b)).map(s => {
+      const d = depth(s);
+      return `<div class="bar-row">` +
+        `<div class="bar-label" style="padding-left:${d * 10}px"` +
+        ` title="${esc(s.name)}">${esc(s.name)}</div>` +
+        `<div class="bar-lane"><div class="bar` +
+        `${s.status === "error" ? " failed" : ""}"` +
+        ` style="left:${(100 * (s.start_ts - t0) / span).toFixed(3)}%;` +
+        `width:${Math.max(100 * (s.end_ts - s.start_ts) / span, .15)
+          .toFixed(3)}%;` +
+        `background:${PHASE_COLORS[s.phase] || PHASE_COLORS.other}"` +
+        ` title="${esc(s.name)} ${((s.end_ts - s.start_ts) * 1e3)
+          .toFixed(2)}ms (${esc(s.phase || "?")})"></div></div></div>`;
+    }).join("");
+  } catch (e) { box.innerHTML = "error: " + esc(e); }
+}
+document.getElementById("tr-load").onclick = loadTraces;
+document.getElementById("tr-show").onclick = showWaterfall;
 document.getElementById("taskstate").onchange = refresh;
 refresh();
 setInterval(refresh, 4000);

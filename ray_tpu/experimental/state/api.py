@@ -213,6 +213,27 @@ def summarize_cluster() -> Dict[str, Any]:
     return _gcs_call("summarize")
 
 
+def list_traces(filters: Optional[Dict[str, Any]] = None,
+                limit: Optional[int] = None,
+                continuation_token: Optional[str] = None,
+                page_size: Optional[int] = None) -> StateListResult:
+    """Trace summaries from the GCS's bounded trace table (explicit
+    spans + task-only traces): {trace_id, root, spans, start_ts,
+    duration_s, status}. Filter keys (pushed down): status, root.
+    ``dropped`` reports spans the bounded table has evicted."""
+    return _list_paged("list_traces", filters, limit,
+                       continuation_token, page_size)
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """One trace's full span set in one RPC: explicit spans (serve
+    request, dag hops, object pulls) merged with task-lifecycle spans
+    the GCS synthesizes from the task table. Feed the result's
+    ``spans`` to ``tracing.critical_path`` / ``tracing.tree_complete``
+    (docs/TRACING.md)."""
+    return _gcs_call("get_trace", {"trace_id": trace_id})
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Per-function task aggregation (`ray-tpu summary tasks`):
     {summary: [{name, count, by_state, mean_duration_s}, ...],

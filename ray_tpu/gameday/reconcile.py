@@ -27,6 +27,10 @@ aggregate the server publishes:
                       controller metrics they claim to export
   C8 chaos-replay     the faults that actually fired are exactly the
                       scenario's seeded schedule (site/op/hit-count)
+  C9 trace-complete   every head-SAMPLED admitted request has a stored
+                      distributed trace forming a complete span tree
+                      (>=1 root, no orphan spans) — the tracing plane
+                      must cover exactly what it claims to sample
 
 Any disagreement fails the check (and, in tier-1, the test) — except
 where the scenario explicitly tolerates records lost with SIGKILLed
@@ -65,6 +69,10 @@ def reconcile(scenario, client_ledger: Dict[str, List[str]],
       prometheus        {serve: {deployment: {metric: value}}}
       chaos_fired       chaos.read_log records
       chaos_expected    the scenario's chaos_config schedule (or None)
+      traces            {rid: [spans]} for the sampled admitted cohort
+      traces_sampled    the rids the runner expected traces for
+      traces_lossy      True when the trace table reported evictions
+                        (completeness then can't be graded exactly)
     """
     checks: List[Dict[str, Any]] = []
     tolerate = bool(getattr(scenario, "tolerate_lost_server_records",
@@ -241,6 +249,44 @@ def reconcile(scenario, client_ledger: Dict[str, List[str]],
     else:
         checks.append(_check("chaos-schedule-replay", True,
                              "no faults scheduled, none fired"))
+
+    # C9: the tracing plane covers exactly what it sampled — every
+    # head-sampled admitted request resolves to a complete span tree
+    sampled_rids = server_view.get("traces_sampled")
+    if sampled_rids is not None:
+        from ray_tpu._private import tracing as _tracing
+        traces = server_view.get("traces") or {}
+        missing_tr, broken = [], []
+        for rid in sampled_rids:
+            spans = traces.get(rid)
+            if not spans:
+                missing_tr.append(rid)
+                continue
+            ok2, detail = _tracing.tree_complete(spans)
+            if not ok2:
+                broken.append(f"{rid}: {detail}")
+        if server_view.get("traces_lossy"):
+            checks.append(_check(
+                "trace-complete", True,
+                f"skipped exact match: trace table lossy "
+                f"({len(missing_tr)} missing, {len(broken)} broken of "
+                f"{len(sampled_rids)} sampled)"))
+        elif tolerate and (missing_tr or broken):
+            checks.append(_check(
+                "trace-complete", True,
+                f"{len(missing_tr)} traces lost with SIGKILLed "
+                f"processes, {len(broken)} broken (tolerated)"))
+        else:
+            checks.append(_check(
+                "trace-complete", not missing_tr and not broken,
+                f"{len(sampled_rids)} sampled admitted requests, "
+                f"{len(missing_tr)} without a trace"
+                + (f" e.g. {missing_tr[:3]}" if missing_tr else "")
+                + (f"; {len(broken)} incomplete trees, e.g. "
+                   f"{broken[:2]}" if broken else "")))
+    else:
+        checks.append(_check("trace-complete", True,
+                             "skipped (no traces collected)"))
 
     return {
         "ok": all(c["ok"] for c in checks),

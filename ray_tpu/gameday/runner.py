@@ -273,7 +273,8 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
 
     prev_env = {k: os.environ.get(k)
                 for k in ("RTPU_CHAOS", "RTPU_CHAOS_LOG",
-                          "RTPU_ACTOR_TASK_EVENTS")}
+                          "RTPU_ACTOR_TASK_EVENTS",
+                          "RTPU_TRACE_SAMPLE")}
     chaos_log = os.path.join(tempfile.mkdtemp(prefix="rtpu-gameday-"),
                              "chaos.jsonl")
     if chaos_cfg is not None:
@@ -284,6 +285,12 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
     # the state-engine cross-check (reconcile C6) needs the task table
     # to see replica request tasks — actor-call events are opt-in
     os.environ["RTPU_ACTOR_TASK_EVENTS"] = "1"
+    # a game day traces EVERY request (default head sampling is 10%):
+    # the trace-completeness check (C9) and the p99 critical-path
+    # aggregation need full span trees, and the run is bounded anyway
+    os.environ.setdefault("RTPU_TRACE_SAMPLE", "1.0")
+    from ray_tpu._private import tracing as _tracing
+    _tracing.refresh()
 
     server_view: Dict[str, Any] = {"chaos_expected": chaos_cfg}
     t_setup = time.time()
@@ -476,6 +483,46 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                                      "op": r.get("op"),
                                      "n": r.get("n")})
 
+        # distributed traces of the sampled admitted cohort: the
+        # tracing plane must hold a complete span tree for every
+        # request it claims to sample (reconcile C9) — and the tail's
+        # traces feed the critical-path aggregation below
+        from ray_tpu._private import tracing
+        from ray_tpu.experimental.state import api as state_api
+        ok_records = [r for r in records if r.outcome == "ok"]
+        sampled = [r.rid for r in ok_records if tracing.sampled(r.rid)]
+        trace_cap = int(os.environ.get("RTPU_GAMEDAY_TRACE_MAX", 500))
+        if len(sampled) > trace_cap:
+            logger.info("gameday: checking %d of %d sampled traces "
+                        "(RTPU_GAMEDAY_TRACE_MAX)", trace_cap,
+                        len(sampled))
+            sampled = sampled[:trace_cap]
+        traces: Dict[str, Any] = {}
+        traces_lossy = False
+
+        def fetch_traces(rids):
+            nonlocal traces_lossy
+            for rid in rids:
+                try:
+                    doc = state_api.get_trace(rid)
+                except Exception:
+                    continue
+                if doc.get("dropped_spans"):
+                    traces_lossy = True
+                if doc.get("spans"):
+                    traces[rid] = doc["spans"]
+
+        fetch_traces(sampled)
+        # one settle pass: the last requests' spans may still be inside
+        # a 0.5 s flush tick (or a draining replica's shutdown flush)
+        from ray_tpu._private.tracing import tree_complete
+        laggards = [rid for rid in sampled
+                    if rid not in traces
+                    or not tree_complete(traces[rid])[0]]
+        if laggards:
+            time.sleep(1.2)
+            fetch_traces(laggards)
+
         server_view.update({
             "replica_ledgers": replica_ledgers,
             "replica_metrics": replica_metrics,
@@ -484,6 +531,9 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
             "prometheus": ({"serve": _parse_serve_gauges(prom_text)}
                            if prom_text is not None else {}),
             "chaos_fired": fired_unique,
+            "traces": traces,
+            "traces_sampled": sampled,
+            "traces_lossy": traces_lossy,
         })
 
         # ---- grade + publish ----
@@ -512,6 +562,14 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
         report["action_errors"] = action_errors
         report["chaos_fired"] = fired_unique
         report["reconciliation"] = recon
+        # where does the tail spend its time? aggregate critical path
+        # over the p99 cohort's traces (ISSUE 13: latency attribution
+        # before optimization)
+        p99_ms = report.get("overall", {}).get("p99_ms") or 0.0
+        cohort = [traces[r.rid] for r in ok_records
+                  if r.rid in traces and r.latency_s * 1e3 >= p99_ms]
+        report["critical_path_p99"] = \
+            tracing.aggregate_critical_path(cohort[:50])
         burn = report["slo"]["availability_burn"]
         report["passed"] = (recon["ok"] and not action_errors
                             and 0.0 <= burn <= 1.0)
@@ -539,4 +597,6 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        from ray_tpu._private import tracing as _tracing
+        _tracing.refresh()
         chaos.clear()

@@ -157,6 +157,21 @@ def build_report(records: Iterable[Any], *,
                                   else -1.0),
         },
     }
+    # the worst admitted requests, each linked to its distributed trace
+    # (trace id == request id end to end), so the SLO report's tail is
+    # one `ray-tpu trace critical-path` away from an explanation
+    try:
+        from ray_tpu._private import tracing
+        admitted = [r for r in records if r.outcome == "ok"]
+        admitted.sort(key=lambda r: -r.latency_s)
+        report["slowest"] = [
+            {"rid": r.rid, "latency_ms": round(r.latency_s * 1e3, 3),
+             "phase": r.phase, "trace_id": r.rid,
+             "trace_sampled": tracing.sampled(r.rid)}
+            for r in admitted[:10]]
+    except Exception:
+        report["slowest"] = []
+
     if latency_target_ms is not None:
         slow = sum(1 for r in records if r.outcome == "ok"
                    and r.latency_s * 1e3 > latency_target_ms)

@@ -238,6 +238,125 @@ def cmd_summary(args):
           file=sys.stderr)
 
 
+def cmd_trace_list(args):
+    """`ray-tpu trace list`: recent traces from the GCS trace table."""
+    _connect(args.address)
+    from ray_tpu.experimental.state import api as state
+    rows = state.list_traces(limit=args.limit)
+    view = sorted(rows, key=lambda r: -(r.get("start_ts") or 0))
+    for r in view:
+        r["start"] = time.strftime(
+            "%H:%M:%S", time.localtime(r.get("start_ts") or 0))
+    _print_table(view, ["trace_id", "root", "spans", "start",
+                        "duration_s", "status"])
+    if rows.dropped:
+        print(f"{rows.dropped} spans evicted past the table cap",
+              file=sys.stderr)
+
+
+def cmd_trace_show(args):
+    """`ray-tpu trace show <id>`: the span tree, indented; --chrome
+    writes a chrome://tracing document merged with any XLA device
+    spans (tpu_profiler) on the same wall-clock axis."""
+    _connect(args.address)
+    from ray_tpu._private import tracing
+    from ray_tpu.experimental.state import api as state
+    doc = state.get_trace(args.trace_id)
+    spans = doc.get("spans") or []
+    if not spans:
+        sys.exit(f"no spans for trace {args.trace_id!r}")
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(tracing.export_chrome(spans), f)
+        print(f"wrote {args.chrome} ({len(spans)} spans)")
+        return
+    if args.json:
+        print(json.dumps(doc, indent=2, default=str))
+        return
+    by_parent = {}
+    ids = {s.get("span_id") for s in spans}
+    for s in spans:
+        p = s.get("parent_span_id")
+        key = p if p in ids else None
+        by_parent.setdefault(key, []).append(s)
+    t0 = min(s["start_ts"] for s in spans if s.get("start_ts"))
+
+    def walk(parent, depth):
+        for s in sorted(by_parent.get(parent, ()),
+                        key=lambda x: x.get("start_ts") or 0):
+            dur = ((s.get("end_ts") or 0) - (s.get("start_ts") or 0))
+            off = (s.get("start_ts") or t0) - t0
+            mark = "" if s.get("status") in (None, "ok") \
+                else f"  [{s['status'].upper()}]"
+            print(f"{'  ' * depth}{s.get('name')}  "
+                  f"+{off * 1e3:.2f}ms {dur * 1e3:.2f}ms "
+                  f"({s.get('phase')}){mark}")
+            walk(s.get("span_id"), depth + 1)
+
+    walk(None, 0)
+    ok, detail = tracing.tree_complete(spans)
+    print(f"tree: {'complete' if ok else 'INCOMPLETE'} — {detail}",
+          file=sys.stderr)
+
+
+def cmd_trace_critical_path(args):
+    """`ray-tpu trace critical-path <id>`: attribute the trace's wall
+    time to named phases (queue/schedule/dispatch/transfer/execute/
+    deserialize) with the deepest-span sweep; --gameday-p99 aggregates
+    the published game-day report's p99 cohort instead."""
+    _connect(args.address)
+    from ray_tpu._private import tracing
+    from ray_tpu.experimental.state import api as state
+    if args.trace_id:
+        doc = state.get_trace(args.trace_id)
+        spans = doc.get("spans") or []
+        if not spans:
+            sys.exit(f"no spans for trace {args.trace_id!r}")
+        cp = tracing.critical_path(spans)
+        total = cp["total_s"] or 1.0
+        print(f"trace {args.trace_id}: {cp['total_s'] * 1e3:.2f}ms "
+              f"wall, {cp['attributed_frac'] * 100:.1f}% attributed")
+        _print_table(
+            [{"phase": k, "ms": round(v * 1e3, 3),
+              "pct": round(100 * v / total, 1)}
+             for k, v in cp["phases"].items()],
+            ["phase", "ms", "pct"])
+        if args.segments:
+            base = cp["segments"][0]["t0"] if cp["segments"] else 0.0
+            for seg in cp["segments"]:
+                off_ms = (seg["t0"] - base) * 1e3
+                dur_ms = (seg["t1"] - seg["t0"]) * 1e3
+                print(f"  +{off_ms:8.2f}ms  {dur_ms:8.2f}ms  "
+                      f"{seg['phase']:<12} {seg['name']}")
+        return
+    # --gameday-p99: the published report names the slowest requests;
+    # aggregate their traces (where does the tail spend its time?)
+    from ray_tpu.gameday import store as gd_store
+    report = gd_store.load_report()
+    if not report:
+        sys.exit("no trace id given and no game-day report published")
+    slowest = report.get("slowest") or []
+    traces = []
+    for entry in slowest:
+        tid = entry.get("trace_id")
+        if not tid:
+            continue
+        spans = state.get_trace(tid).get("spans") or []
+        if spans:
+            traces.append(spans)
+    if not traces:
+        sys.exit("the published report's slowest requests have no "
+                 "stored traces (sampled out or evicted)")
+    agg = tracing.aggregate_critical_path(traces)
+    print(f"{agg['traces']} tail traces, "
+          f"{agg['total_s'] * 1e3:.1f}ms total")
+    _print_table(
+        [{"phase": k, "ms": round(v * 1e3, 3),
+          "pct": round(100 * agg.get("phase_frac", {}).get(k, 0), 1)}
+         for k, v in agg["phases"].items()],
+        ["phase", "ms", "pct"])
+
+
 def cmd_events(args):
     _connect(args.address)
     from ray_tpu.experimental.state import api as state
@@ -445,6 +564,33 @@ def main(argv=None):
     sp.add_argument("what", choices=["tasks"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(func=cmd_summary)
+
+    tp = sub.add_parser(
+        "trace", help="distributed traces (docs/TRACING.md)")
+    tsub = tp.add_subparsers(dest="trace_command", required=True)
+    sp = tsub.add_parser("list", help="recent traces")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=50)
+    sp.set_defaults(func=cmd_trace_list)
+    sp = tsub.add_parser("show", help="span tree of one trace")
+    sp.add_argument("trace_id")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="write a chrome://tracing doc (merged with "
+                         "XLA device spans on one time axis)")
+    sp.set_defaults(func=cmd_trace_show)
+    sp = tsub.add_parser(
+        "critical-path",
+        help="attribute a trace's wall time to named phases")
+    sp.add_argument("trace_id", nargs="?", default=None)
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--segments", action="store_true",
+                    help="print the attributed time slices")
+    sp.add_argument("--gameday-p99", action="store_true",
+                    help="aggregate the published game-day report's "
+                         "slowest requests instead of one trace")
+    sp.set_defaults(func=cmd_trace_critical_path)
 
     sp = sub.add_parser("events", help="structured cluster events")
     sp.add_argument("--address", default=None)

@@ -44,7 +44,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import chaos
+from ray_tpu._private import chaos, tracing
 from ray_tpu.serve.exceptions import ReplicaOverloadedError
 
 # EWMA smoothing for per-request service time: heavy enough to damp
@@ -55,6 +55,11 @@ _EWMA_ALPHA = 0.3
 # reserved kwarg carrying a client-supplied request id; stripped before
 # the user callable sees kwargs
 REQUEST_ID_KWARG = "__rtpu_request_id__"
+
+# reserved kwarg carrying the router's span context ({"trace_id",
+# "span_id"}); stripped before user code, parents this replica's
+# queue/execute spans (docs/TRACING.md)
+TRACE_CTX_KWARG = "__rtpu_trace_ctx__"
 
 
 def _default_max_queued(max_concurrent_queries: int) -> int:
@@ -165,7 +170,9 @@ class ReplicaActor:
 
     def _execute(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         t0 = time.monotonic()
+        t0_wall = time.time()
         rid = kwargs.pop(REQUEST_ID_KWARG, None) if kwargs else None
+        tctx = kwargs.pop(TRACE_CTX_KWARG, None) if kwargs else None
         if chaos._ENGINE is not None:
             # chaos injection point: "kill" at the N-th request this
             # replica accepted (method filter = deployment name)
@@ -180,6 +187,14 @@ class ReplicaActor:
                 # published table
                 self._total_shed += 1
                 self._record_request_locked(rid, "shed", 0.0)
+                if tctx:
+                    tracing.record_span(
+                        tctx["trace_id"], tracing.new_span_id(),
+                        f"replica.shed:{self.replica_name}",
+                        parent_span_id=tctx.get("span_id"),
+                        kind="serve.replica", phase="queue",
+                        start_ts=t0_wall, end_ts=time.time(),
+                        status="shed")
                 raise ReplicaOverloadedError(self.deployment_name,
                                              in_flight, limit)
             self._queued += 1
@@ -188,6 +203,34 @@ class ReplicaActor:
         with self._ongoing_lock:
             self._queued -= 1
             self._ongoing += 1
+        # replica-side spans: the bounded-ingress wait ("queue") then
+        # user code ("execute"); the execute span is installed as the
+        # worker's current trace ctx so tasks/actor calls the user code
+        # makes nest under this request in the trace tree
+        exec_span = None
+        prev_trace = worker = None
+        if tctx and tctx.get("trace_id"):
+            t_q = time.time()
+            if t_q - t0_wall > 1e-4:  # don't record empty queue waits
+                tracing.record_span(
+                    tctx["trace_id"], tracing.new_span_id(),
+                    f"replica.queue:{self.replica_name}",
+                    parent_span_id=tctx.get("span_id"),
+                    kind="serve.replica", phase="queue",
+                    start_ts=t0_wall, end_ts=t_q)
+            exec_span = tracing.span_if(
+                tctx["trace_id"], f"replica.execute:{self.replica_name}",
+                parent_span_id=tctx.get("span_id"),
+                kind="serve.replica", phase="execute",
+                attrs={"deployment": self.deployment_name,
+                       "method": method_name or "__call__"})
+            if exec_span is not None:
+                from ray_tpu._private import worker as worker_mod
+                worker = worker_mod._global_worker
+                if worker is not None:
+                    prev_trace = getattr(worker.task_context, "trace",
+                                         None)
+                    worker.task_context.trace = exec_span.trace_ctx()
         outcome = "ok"
         try:
             if self._is_function:
@@ -201,6 +244,10 @@ class ReplicaActor:
                 self._total_errors += 1
             raise
         finally:
+            if worker is not None:
+                worker.task_context.trace = prev_trace
+            if exec_span is not None:
+                exec_span.finish("ok" if outcome == "ok" else "error")
             self._exec_sem.release()
             dt = time.monotonic() - t0
             with self._ongoing_lock:
@@ -306,6 +353,13 @@ class ReplicaActor:
         try:
             from ray_tpu._private import task_events as tev
             tev.flush_all(timeout=2.0)
+        except Exception:
+            pass
+        # same for the trace-span ring: a replica retired by a rolling
+        # update must not take the tail of its request spans with it
+        # (the gameday trace-completeness check joins against them)
+        try:
+            tracing.flush_all(timeout=2.0)
         except Exception:
             pass
         # flush the request ledger before dying: a replica retired by a

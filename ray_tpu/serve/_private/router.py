@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import ray_tpu
 from ray_tpu import exceptions as rexc
+from ray_tpu._private import tracing
 from ray_tpu.actor import get_actor_by_id
 from ray_tpu.serve._private.long_poll import LongPollClient
 from ray_tpu.serve.exceptions import ReplicaOverloadedError
@@ -43,6 +44,25 @@ def _load_staleness_s() -> float:
 
 def _default_policy() -> str:
     return os.environ.get("RTPU_SERVE_ROUTING", "p2c").strip().lower()
+
+
+def _call_under_span(span: Optional["tracing.Span"], fn):
+    """Run ``fn`` (an actor-call submit) with ``span`` installed as the
+    caller's current trace ctx: the call's task-event record then joins
+    the serve trace (visible under RTPU_ACTOR_TASK_EVENTS=1) instead of
+    the caller process's root trace."""
+    if span is None:
+        return fn()
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod._global_worker
+    if w is None:
+        return fn()
+    prev = getattr(w.task_context, "trace", None)
+    w.task_context.trace = span.trace_ctx()
+    try:
+        return fn()
+    finally:
+        w.task_context.trace = prev
 
 
 def is_overload_error(err: BaseException) -> bool:
@@ -297,20 +317,77 @@ class Router:
     def assign_request(self, deployment_name: str, method_name: str,
                        args: tuple, kwargs: dict,
                        timeout: float = 30.0,
-                       exclude: Optional[Set[str]] = None):
+                       exclude: Optional[Set[str]] = None,
+                       trace_parent: Optional[Dict[str, str]] = None):
         """Pick a replica, fire the call; returns (ObjectRef, done_cb,
-        replica handle)."""
+        replica handle).
+
+        Tracing: each call opens a ``serve.request`` root span (trace
+        id = the ``__rtpu_request_id__`` kwarg when tagged, so the SLO
+        report links a slow request straight to its trace) with a
+        ``router.assign`` child covering replica selection — the wait
+        for a free slot IS the router-side queueing the analyzer must
+        see. The root closes in the done callback, i.e. at the same
+        instant the caller observes completion. ``trace_parent`` nests
+        this request under an enclosing span (the HTTP proxy's)."""
         rs = self.replica_set(deployment_name)
-        replica = rs.assign(timeout=timeout, exclude=exclude)
-        ref = replica.handle_request.remote(method_name, args, kwargs)
-        return ref, lambda: rs.release(replica), replica
+        root = None
+        sampled = False
+        if tracing.enabled():
+            from ray_tpu.serve._private.replica import (REQUEST_ID_KWARG,
+                                                        TRACE_CTX_KWARG)
+            rid = (kwargs or {}).get(REQUEST_ID_KWARG)
+            root = tracing.Span(
+                (trace_parent or {}).get("trace_id") or rid
+                or tracing.new_trace_id(),
+                f"serve.request:{deployment_name}",
+                parent_span_id=(trace_parent or {}).get("span_id"),
+                kind="serve.request", phase="transfer",
+                attrs={"deployment": deployment_name,
+                       "request_id": rid})
+            # head-sampling decides HERE whether the request is traced
+            # end to end: only sampled requests pay for context
+            # propagation and child spans; an unsampled root costs two
+            # clock reads and is still tail-kept when slow
+            sampled = tracing.sampled(root.trace_id)
+            if sampled:
+                kwargs = dict(kwargs) if kwargs else {}
+                kwargs[TRACE_CTX_KWARG] = root.child_ctx()
+        t_assign = time.time()
+        try:
+            replica = rs.assign(timeout=timeout, exclude=exclude)
+        except BaseException:
+            if root is not None:
+                root.finish("error")
+            raise
+        if sampled and time.time() - t_assign > 1e-4:
+            # the wait for a free replica slot is router-side queueing;
+            # a no-wait assign is noise and not worth a span
+            tracing.record_span(
+                root.trace_id, tracing.new_span_id(), "router.assign",
+                parent_span_id=root.span_id, kind="serve.router",
+                phase="schedule", start_ts=t_assign,
+                end_ts=time.time())
+        ref = _call_under_span(
+            root if sampled else None,
+            lambda: replica.handle_request.remote(
+                method_name, args, kwargs))
+        if root is None:
+            return ref, lambda: rs.release(replica), replica
+
+        def done():
+            rs.release(replica)
+            root.finish()
+        return ref, done, replica
 
     def execute_request(self, deployment_name: str, method_name: str,
                         args: tuple, kwargs: dict, *,
                         get_timeout: float = 60.0,
                         assign_timeout: float = 30.0,
                         overload_retries: Optional[int] = None,
-                        request_id: Optional[str] = None) -> Any:
+                        request_id: Optional[str] = None,
+                        trace_parent: Optional[Dict[str, str]] = None
+                        ) -> Any:
         """Synchronous request with overload retry — the proxy hot path.
 
         Uses the replica's envelope method so each response piggybacks
@@ -331,6 +408,41 @@ class Router:
         if request_id is not None:
             from ray_tpu.serve._private.replica import REQUEST_ID_KWARG
             kwargs = {**(kwargs or {}), REQUEST_ID_KWARG: request_id}
+        root = None
+        sampled = False
+        if tracing.enabled():
+            from ray_tpu.serve._private.replica import TRACE_CTX_KWARG
+            root = tracing.Span(
+                (trace_parent or {}).get("trace_id") or request_id
+                or tracing.new_trace_id(),
+                f"serve.request:{deployment_name}",
+                parent_span_id=(trace_parent or {}).get("span_id"),
+                kind="serve.request", phase="transfer",
+                attrs={"deployment": deployment_name,
+                       "request_id": request_id})
+            sampled = tracing.sampled(root.trace_id)
+            if sampled:
+                kwargs = {**(kwargs or {}),
+                          TRACE_CTX_KWARG: root.child_ctx()}
+        try:
+            out = self._execute_attempts(
+                deployment_name, method_name, args, kwargs,
+                get_timeout=get_timeout, assign_timeout=assign_timeout,
+                overload_retries=overload_retries,
+                root=root if sampled else None)
+        except BaseException:
+            if root is not None:
+                root.finish("error")
+            raise
+        if root is not None:
+            root.finish()
+        return out
+
+    def _execute_attempts(self, deployment_name: str, method_name: str,
+                          args: tuple, kwargs: dict, *,
+                          get_timeout: float, assign_timeout: float,
+                          overload_retries: Optional[int],
+                          root: Optional["tracing.Span"] = None) -> Any:
         if overload_retries is None:
             try:
                 overload_retries = int(os.environ.get(
@@ -341,9 +453,18 @@ class Router:
         exclude: Set[str] = set()
         last_err: Optional[BaseException] = None
         for _ in range(max(1, overload_retries + 1)):
-            replica = rs.assign(timeout=assign_timeout, exclude=exclude)
-            ref = replica.handle_request_with_load.remote(
-                method_name, args, kwargs)
+            t_assign = time.time()
+            replica = rs.assign(timeout=assign_timeout,
+                                exclude=exclude)
+            if root is not None and time.time() - t_assign > 1e-4:
+                tracing.record_span(
+                    root.trace_id, tracing.new_span_id(),
+                    "router.assign", parent_span_id=root.span_id,
+                    kind="serve.router", phase="schedule",
+                    start_ts=t_assign, end_ts=time.time())
+            ref = _call_under_span(
+                root, lambda: replica.handle_request_with_load.remote(
+                    method_name, args, kwargs))
             try:
                 out = ray_tpu.get(ref, timeout=get_timeout)
             except Exception as e:

@@ -133,6 +133,22 @@ class HTTPProxyActor:
                         "RTPU_SERVE_PROXY_ASSIGN_TIMEOUT_S", 5.0))
                 except ValueError:
                     assign_timeout = 5.0
+                # proxy root span: everything below (route match,
+                # router assign, replica, retries) nests under it, so
+                # `ray-tpu trace critical-path` attributes the full
+                # proxy-observed latency; trace id = X-Request-Id when
+                # the client sent one (echoed as X-Trace-Id either way)
+                from ray_tpu._private import tracing
+                proxy_span = None
+                if tracing.enabled():
+                    proxy_span = tracing.Span(
+                        self._request_id or tracing.new_trace_id(),
+                        f"serve.proxy:{name}", kind="serve.proxy",
+                        phase="transfer",
+                        attrs={"path": parsed.path,
+                               "method": self.command,
+                               "request_id": self._request_id})
+                    self._proxy_span = proxy_span  # closed in _respond
                 for attempt in range(attempts):
                     try:
                         kwargs = {}
@@ -152,7 +168,9 @@ class HTTPProxyActor:
                             (payload,) if payload is not None else (),
                             kwargs, get_timeout=60.0,
                             assign_timeout=assign_timeout,
-                            request_id=self._request_id)
+                            request_id=self._request_id,
+                            trace_parent=(proxy_span.child_ctx()
+                                          if proxy_span else None))
                         if isinstance(result, dict) and \
                                 "__serve_http_status__" in result:
                             # structured routing miss from an ingress
@@ -217,6 +235,11 @@ class HTTPProxyActor:
                                         "retryable": True})
 
             def _respond(self, code: int, result: Any):
+                sp = getattr(self, "_proxy_span", None)
+                if sp is not None:
+                    self._proxy_span = None
+                    sp.finish("ok" if code < 400
+                              else "shed" if code == 503 else "error")
                 try:
                     data = json.dumps(result).encode()
                     ctype = "application/json"
@@ -228,6 +251,10 @@ class HTTPProxyActor:
                 self.send_header("Content-Length", str(len(data)))
                 if getattr(self, "_request_id", None):
                     self.send_header("X-Request-Id", self._request_id)
+                if sp is not None:
+                    # the join key for `ray-tpu trace show` even when the
+                    # client sent no X-Request-Id
+                    self.send_header("X-Trace-Id", sp.trace_id)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -19,36 +19,57 @@ _events: List[Dict[str, Any]] = []
 _lock = threading.Lock()
 _MAX_EVENTS = 10_000  # ring-buffer cap: bounds memory + kv payload
 _total_recorded = 0  # monotonic: dirty-check survives ring trimming
+_dropped = 0  # monotonic: events the ring trimmed (surfaced in dumps)
 _flusher_started = False
+_flusher_stop = None
 
 
 def _ensure_flusher():
     """Background flusher so events recorded just before a worker goes
     idle still reach the GCS (flush-on-record alone would strand them
     inside the min_interval window)."""
-    global _flusher_started
+    global _flusher_started, _flusher_stop
     if _flusher_started:
         return
     _flusher_started = True
+    stop = _flusher_stop = threading.Event()
 
     def loop():
-        while True:
-            time.sleep(1.0)
+        while not stop.wait(1.0):
             try:
                 flush()
             except Exception:
                 pass
 
-    threading.Thread(target=loop, daemon=True).start()
+    threading.Thread(target=loop, daemon=True,
+                     name="rtpu-timeline-flush").start()
+
+
+def stop_flusher():
+    """Worker shutdown hook: end the flusher thread and reset the
+    started flag so a reconnect in the same process starts a fresh one
+    (the unreset flag leaked one daemon thread per init/shutdown
+    cycle)."""
+    global _flusher_started, _flusher_stop
+    if _flusher_stop is not None:
+        _flusher_stop.set()
+    _flusher_stop = None
+    _flusher_started = False
+
+
+def dropped_count() -> int:
+    with _lock:
+        return _dropped
 
 
 def record(name, ph, ts, pid=0, tid=0, **kw):
-    global _total_recorded
+    global _total_recorded, _dropped
     with _lock:
         _events.append({"name": name, "ph": ph, "ts": ts, "pid": pid,
                         "tid": tid, **kw})
         _total_recorded += 1
         if len(_events) > _MAX_EVENTS:
+            _dropped += len(_events) - _MAX_EVENTS
             del _events[:len(_events) - _MAX_EVENTS]
 
 
@@ -68,9 +89,10 @@ def record_task(name: str, t0: float, t1: float, pid: int = 0,
             "cat": "task",
             "args": dict(trace_ctx or {}),
         })
-        global _total_recorded
+        global _total_recorded, _dropped
         _total_recorded += 1
         if len(_events) > _MAX_EVENTS:
+            _dropped += len(_events) - _MAX_EVENTS
             del _events[:len(_events) - _MAX_EVENTS]
     # async: the background flusher pushes to GCS so the task-completion
     # path never blocks on a kv_put
@@ -106,6 +128,11 @@ def flush():
         if _total_recorded == _last_pushed_total:
             return
         events = list(_events)
+        if _dropped:
+            # ring-trim loss travels WITH the buffer: the merged dump
+            # can report "history missing" instead of silently looking
+            # complete (metadata event, invisible to the track renderer)
+            events.append(_dropped_meta(_dropped))
         snapshot = _total_recorded
     try:
         w.call_sync(w.gcs, "kv_put", {
@@ -117,6 +144,19 @@ def flush():
     with _lock:
         # concurrent flushes may complete out of order; never regress
         _last_pushed_total = max(_last_pushed_total, snapshot)
+
+
+def _dropped_meta(n: int) -> Dict[str, Any]:
+    return {"name": "rtpu_timeline_dropped", "ph": "M", "ts": 0,
+            "pid": os.getpid(), "tid": 0, "args": {"dropped": n}}
+
+
+def dump_dropped_total(events: List[Dict[str, Any]]) -> int:
+    """Sum of ring-trim losses across every process's buffer in a
+    merged dump (the dashboard surfaces this next to the timeline)."""
+    return sum(int((e.get("args") or {}).get("dropped") or 0)
+               for e in events
+               if e.get("name") == "rtpu_timeline_dropped")
 
 
 def timeline_dump() -> List[Dict[str, Any]]:
@@ -140,5 +180,8 @@ def timeline_dump() -> List[Dict[str, Any]]:
             pass
     if not merged:
         merged = collect()
+        with _lock:
+            if _dropped:
+                merged.append(_dropped_meta(_dropped))
     return [{k: v for k, v in e.items() if v is not None}
             for e in merged]

@@ -18,6 +18,10 @@ os.environ.setdefault("RTPU_PRESTART_WORKERS", "0")
 # declared wire schema (_private/schema.py) — handler/schema drift
 # fails loudly here instead of silently skewing the protocol.
 os.environ.setdefault("RTPU_VALIDATE_WIRE", "1")
+# Full head-sampling in tests: production defaults to 10% (Dapper
+# stance, bounds serve overhead — see _private/tracing.py), but tests
+# assert on complete span trees for specific request ids.
+os.environ.setdefault("RTPU_TRACE_SAMPLE", "1.0")
 
 # Tune writes experiment dirs (loggers + resumable state) to this root by
 # default; keep test runs out of $HOME.

@@ -1,6 +1,7 @@
 """Tracing + on-demand profiling (reference:
 util/tracing/tracing_helper.py span propagation through TaskSpecs and
-dashboard/modules/reporter/profile_manager.py live worker profiling)."""
+dashboard/modules/reporter/profile_manager.py live worker profiling;
+the span model / critical-path analyzer is docs/TRACING.md)."""
 
 import time
 
@@ -8,11 +9,12 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu._private import tracing
 
 
 @pytest.fixture(scope="module")
 def cluster():
-    ctx = ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+    ctx = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
                        object_store_memory=128 * 1024 * 1024)
     yield ctx
     ray_tpu.shutdown()
@@ -147,3 +149,390 @@ def test_flamegraph_of_busy_worker(cluster):
     assert line.rsplit(" ", 1)[1].isdigit()
     assert all(p["samples"] > 0 for p in profiles)
     assert ray_tpu.get(ref, timeout=60) > 0
+
+
+# ---------------------------------------------------------------- spans
+
+
+def test_nested_actor_task_chain_parents_under_caller(cluster):
+    """Regression (ISSUE 13 satellite): a task submitted from inside an
+    executing actor method must parent under the CALL's span — the
+    actor worker's _root_trace used to take over at the actor boundary,
+    severing every serve-replica/actor trace tree. 3-deep chain:
+    driver -> actor.method -> task -> task, one trace throughout."""
+
+    @ray_tpu.remote
+    def na_leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def na_mid(x):
+        return ray_tpu.get(na_leaf.remote(x)) + 10
+
+    @ray_tpu.remote
+    class NaActor:
+        def go(self, x):
+            return ray_tpu.get(na_mid.remote(x)) + 100
+
+    a = NaActor.remote()
+    assert ray_tpu.get(a.go.remote(1), timeout=60) == 112
+    from ray_tpu._private.worker import global_worker
+    driver_trace = global_worker()._current_trace()["trace_id"]
+
+    deadline = time.monotonic() + 15
+    evs = {}
+    while time.monotonic() < deadline:
+        for e in ray_tpu.timeline():
+            if e.get("cat") == "task" and \
+                    (e.get("args") or {}).get("trace_id"):
+                evs[e["name"]] = e["args"]
+        if {"na_mid", "na_leaf"} <= set(evs):
+            break
+        time.sleep(0.5)
+    assert {"na_mid", "na_leaf"} <= set(evs), sorted(evs)
+    mid, leaf = evs["na_mid"], evs["na_leaf"]
+    # one trace rooted at the DRIVER (not a per-actor-worker root)
+    assert mid["trace_id"] == driver_trace, \
+        "actor boundary severed the trace (fresh root trace)"
+    assert leaf["trace_id"] == driver_trace
+    # the mid task's parent is the actor CALL's span, which itself is a
+    # child of the driver root — so it can't be "root"
+    assert mid["parent_span_id"] != "root"
+    assert leaf["parent_span_id"] == mid["span_id"]
+
+
+def test_record_span_head_sampling_and_tail_keep(monkeypatch):
+    """RTPU_TRACE_SAMPLE=0 head-samples everything out, but slow and
+    failed spans are always kept (the tail is the point)."""
+    got = []
+    tracing.set_sender(lambda p: got.extend(p["spans"]) or True)
+    monkeypatch.setenv("RTPU_TRACE_SAMPLE", "0.0")
+    monkeypatch.setenv("RTPU_TRACE_SLOW_S", "0.5")
+    tracing.refresh()
+    try:
+        t = time.time()
+        tracing.record_span("t-fast", "s1", "fast", start_ts=t,
+                            end_ts=t + 0.01)
+        tracing.record_span("t-failed", "s2", "failed", start_ts=t,
+                            end_ts=t + 0.01, status="error")
+        tracing.record_span("t-slow", "s3", "slow", start_ts=t,
+                            end_ts=t + 2.0)
+        tracing.flush()
+        names = {s["name"] for s in got}
+        assert names == {"failed", "slow"}, names
+        # and sampled() is deterministic at fractional rates
+        monkeypatch.setenv("RTPU_TRACE_SAMPLE", "0.5")
+        tracing.refresh()
+        assert all(tracing.sampled("x%d" % i) == tracing.sampled(
+            "x%d" % i) for i in range(50))
+        kept = sum(tracing.sampled("y%d" % i) for i in range(400))
+        assert 100 < kept < 300  # hash-uniform, not all-or-nothing
+    finally:
+        tracing.set_sender(None)
+        # restore the conftest default (1.0) BEFORE refreshing: the
+        # cached rate must not leak a partial-sampling state into the
+        # rest of the suite (monkeypatch's own undo runs after this)
+        monkeypatch.setenv("RTPU_TRACE_SAMPLE", "1.0")
+        monkeypatch.setenv("RTPU_TRACE_SLOW_S", "1.0")
+        tracing.refresh()
+
+
+def test_trace_table_bounded_with_drop_counter():
+    from ray_tpu._private.gcs import TraceTable
+    t = TraceTable(cap=100, per_trace_cap=10)
+    for i in range(50):
+        for j in range(4):
+            t.apply({"trace_id": f"tr{i}", "span_id": f"s{j}",
+                     "name": "n", "start_ts": float(i),
+                     "end_ts": float(i) + 1})
+    assert t.total_spans <= 100
+    assert t.dropped_spans == 200 - t.total_spans
+    # newest traces survive (oldest-updated evicted first)
+    assert t.get("tr49") and not t.get("tr0")
+    # per-trace cap: one hot trace can't eat the table
+    for j in range(50):
+        t.apply({"trace_id": "hot", "span_id": f"h{j}", "name": "n",
+                 "start_ts": 0.0, "end_ts": 1.0})
+    assert len(t.get("hot")) == 10
+    rows = {r["trace_id"]: r for r in t.summary_rows()}
+    assert rows["hot"]["spans"] == 10
+
+
+def test_critical_path_attribution_unit():
+    """Deepest-active-span sweep: overlap never double-counts, gaps
+    fall to the enclosing span, the table sums to the root's wall."""
+    spans = [
+        {"trace_id": "t", "span_id": "r", "name": "root",
+         "phase": "transfer", "start_ts": 0.0, "end_ts": 0.100},
+        {"trace_id": "t", "span_id": "q", "parent_span_id": "r",
+         "name": "q", "phase": "queue", "start_ts": 0.0,
+         "end_ts": 0.020},
+        {"trace_id": "t", "span_id": "e", "parent_span_id": "r",
+         "name": "e", "phase": "execute", "start_ts": 0.020,
+         "end_ts": 0.090},
+        {"trace_id": "t", "span_id": "d", "parent_span_id": "e",
+         "name": "d", "phase": "deserialize", "start_ts": 0.020,
+         "end_ts": 0.030},
+    ]
+    cp = tracing.critical_path(spans)
+    ph = cp["phases"]
+    assert abs(ph["queue"] - 0.020) < 1e-9
+    assert abs(ph["deserialize"] - 0.010) < 1e-9
+    assert abs(ph["execute"] - 0.060) < 1e-9
+    assert abs(ph["transfer"] - 0.010) < 1e-9  # root residual (gap)
+    assert abs(cp["attributed_s"] - cp["total_s"]) < 1e-9
+    assert cp["attributed_frac"] == 1.0
+    # completeness detector
+    ok, _ = tracing.tree_complete(spans)
+    assert ok
+    ok, detail = tracing.tree_complete(spans + [
+        {"trace_id": "t", "span_id": "x", "parent_span_id": "gone",
+         "name": "orphan", "phase": "other", "start_ts": 0,
+         "end_ts": 1}])
+    assert not ok and "orphan" in detail
+    # aggregate over a cohort
+    agg = tracing.aggregate_critical_path([spans, spans])
+    assert agg["traces"] == 2
+    assert abs(agg["phases"]["execute"] - 0.120) < 1e-9
+
+
+def test_serve_request_trace_end_to_end(cluster):
+    """The flagship acceptance path: a request-id-tagged serve request
+    yields a complete span tree whose critical path attributes >=95%
+    of the client-observed latency to named phases."""
+    from ray_tpu import serve
+    from ray_tpu.experimental.state import api as state
+
+    class TrApp:
+        def __call__(self, x=None):
+            time.sleep(0.02)
+            return {"ok": True}
+
+    h = serve.run(serve.deployment(num_replicas=1)(TrApp).bind(),
+                  name="trace_e2e", route_prefix="/trace_e2e",
+                  http_port=None)
+    try:
+        for i in range(4):  # warm replica + router + codepaths
+            ray_tpu.get(h.remote({"x": 1},
+                                 __rtpu_request_id__=f"tr-warm-{i}"),
+                        timeout=60)
+        rid = "tr-e2e-final"
+        t0 = time.time()
+        ray_tpu.get(h.remote({"x": 1}, __rtpu_request_id__=rid),
+                    timeout=60)
+        client_dt = time.time() - t0
+
+        deadline = time.time() + 15
+        spans = []
+        while time.time() < deadline:
+            spans = state.get_trace(rid).get("spans") or []
+            if len(spans) >= 3 and tracing.tree_complete(spans)[0]:
+                break
+            time.sleep(0.4)
+        names = {s["name"] for s in spans}
+        assert any(n.startswith("serve.request:") for n in names), names
+        assert any(n.startswith("replica.execute:") for n in names), \
+            names
+        ok, detail = tracing.tree_complete(spans)
+        assert ok, detail
+        cp = tracing.critical_path(spans)
+        # >=95% of what the CLIENT measured lands in named phases
+        assert cp["attributed_s"] >= 0.95 * client_dt, \
+            (cp, client_dt)
+        assert cp["phases"].get("execute", 0) > 0.015  # the sleep
+        # the summary row is listable (explicit spans only: root +
+        # replica.execute at minimum — no-wait assign/queue spans are
+        # elided)
+        rows = state.list_traces()
+        assert any(r["trace_id"] == rid and r["spans"] >= 2
+                   for r in rows)
+    finally:
+        # full serve teardown: the module-global router would otherwise
+        # outlive this module's cluster and poison later test files
+        serve.shutdown()
+
+
+def test_trace_api_pagination(cluster):
+    from ray_tpu.experimental.state import api as state
+    seen = {}
+    token = None
+    while True:
+        page = state.list_traces(page_size=2, continuation_token=token)
+        for r in page:
+            assert r["trace_id"] not in seen  # pages never overlap
+            seen[r["trace_id"]] = r
+        token = page.next_token
+        if token is None:
+            break
+    full = state.list_traces()
+    assert set(seen) == {r["trace_id"] for r in full}
+
+
+def test_compiled_dag_hop_spans(cluster):
+    """A >=1.6-negotiated compiled graph chains hop spans through the
+    channel frames; legacy peers would simply omit them (gated)."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class DagTr:
+        def inc(self, x):
+            return x + 1
+
+        def dbl(self, x):
+            return 2 * x
+
+    a = DagTr.bind()
+    with InputNode() as inp:
+        graph = a.dbl.bind(a.inc.bind(inp))
+    dag = graph.compile()
+    try:
+        assert dag._compiled and dag._trace_peers
+        assert dag.execute(5) == 12
+        from ray_tpu.experimental.state import api as state
+        from ray_tpu._private.worker import global_worker
+        trace_id = global_worker()._current_trace()["trace_id"]
+        deadline = time.time() + 15
+        hops = []
+        while time.time() < deadline:
+            spans = state.get_trace(trace_id).get("spans") or []
+            hops = [s for s in spans if s.get("kind") == "dag.hop"]
+            if len(hops) >= 2:
+                break
+            time.sleep(0.4)
+        assert len(hops) >= 2, spans
+        by_name = {s["name"]: s for s in hops}
+        root = next(s for s in spans if s.get("kind") == "dag.execute")
+        assert by_name["dag.stage:inc"]["parent_span_id"] == \
+            root["span_id"]
+        assert by_name["dag.stage:dbl"]["parent_span_id"] == \
+            by_name["dag.stage:inc"]["span_id"]
+    finally:
+        dag.teardown()
+
+
+def test_task_phase_synthesis_from_state_engine(cluster):
+    """get_trace synthesizes queue/schedule/dispatch/execute phase
+    spans for plain tasks from the task table's per-state stamps — no
+    span instrumentation on the task hot path."""
+    from ray_tpu.experimental.state import api as state
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    def synth_work(arr, ms):
+        time.sleep(ms / 1e3)
+        return ms
+
+    # a plasma arg disqualifies the leased fast lane, so the task rides
+    # the raylet queue and picks up queue/schedule/dispatch stamps
+    big = ray_tpu.put(np.zeros(200_000))
+    assert ray_tpu.get(synth_work.remote(big, 30), timeout=60) == 30
+    trace_id = global_worker()._current_trace()["trace_id"]
+    deadline = time.time() + 15
+    task_spans = []
+    while time.time() < deadline:
+        spans = state.get_trace(trace_id).get("spans") or []
+        task_spans = [s for s in spans if s.get("kind") == "task"
+                      and s["name"].startswith("synth_work")]
+        if any(s["phase"] == "execute" for s in task_spans):
+            break
+        time.sleep(0.5)
+    phases = {s["phase"] for s in task_spans}
+    assert "execute" in phases, task_spans
+    assert "queue" in phases or "schedule" in phases, task_spans
+    execute = next(s for s in task_spans if s["phase"] == "execute")
+    assert execute["end_ts"] - execute["start_ts"] >= 0.025
+
+
+def test_dashboard_trace_routes(cluster):
+    import json
+    import urllib.request
+    from ray_tpu.dashboard.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def dtr_noop():
+        return 1
+
+    assert ray_tpu.get(dtr_noop.remote(), timeout=60) == 1
+    time.sleep(1.2)  # task events flush
+    port = start_dashboard(port=18273)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/traces?limit=5",
+            timeout=30) as resp:
+        doc = json.loads(resp.read())
+    assert doc["traces"], doc
+    tid = doc["traces"][0]["trace_id"]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/trace/{tid}",
+            timeout=30) as resp:
+        one = json.loads(resp.read())
+    assert one["spans"]
+    assert "critical_path" in one and "complete" in one
+    # timeline route surfaces the ring drop counter
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/timeline",
+            timeout=30) as resp:
+        tl = json.loads(resp.read())
+    assert "dropped" in tl
+
+
+def test_chrome_export_merges_device_spans():
+    """Trace spans + tpu_profiler XLA rows concatenate onto one
+    wall-clock axis (the `ray-tpu trace show --chrome` document)."""
+    from ray_tpu.util.tpu_profiler import _XLA_PID_BASE
+    now = time.time()
+    spans = [{"trace_id": "t", "span_id": "r", "name": "root",
+              "phase": "execute", "start_ts": now, "end_ts": now + 1}]
+    device = [
+        {"name": "process_name", "ph": "M", "ts": 0,
+         "pid": _XLA_PID_BASE + 7, "args": {"name": "xla host p1"}},
+        {"name": "fusion.1", "ph": "X", "ts": (now + 0.5) * 1e6,
+         "dur": 1000.0, "pid": _XLA_PID_BASE + 7, "tid": 0},
+        {"name": "far-away", "ph": "X", "ts": (now + 3600) * 1e6,
+         "dur": 5.0, "pid": _XLA_PID_BASE + 7, "tid": 0},
+        {"name": "not-xla-row", "ph": "X", "ts": (now + 0.5) * 1e6,
+         "dur": 5.0, "pid": 1234, "tid": 0},
+    ]
+    doc = tracing.export_chrome(spans, device_events=device)
+    names = [e["name"] for e in doc]
+    assert "root" in names and "fusion.1" in names
+    assert "process_name" in names          # XLA lane labels ride along
+    assert "far-away" not in names          # outside the trace window
+    assert "not-xla-row" not in names       # framework rows excluded
+    root_ev = next(e for e in doc if e["name"] == "root")
+    fusion = next(e for e in doc if e["name"] == "fusion.1")
+    # one time axis: both in wall-clock microseconds
+    assert root_ev["ts"] <= fusion["ts"] <= root_ev["ts"] + 1e6
+
+
+def test_timeline_drop_counter_and_flusher_stop():
+    """Satellite: the timeline ring reports what it trims, and the
+    flusher thread dies on stop_flusher (one thread leaked per
+    init/shutdown cycle before)."""
+    import threading
+    from ray_tpu.util import timeline
+
+    base = timeline.dropped_count()
+    for i in range(timeline._MAX_EVENTS + 50):
+        timeline.record("spam", "X", float(i))
+    assert timeline.dropped_count() >= base + 50
+    # the dump carries the loss marker (per-process metadata event)
+    evs = timeline.timeline_dump()
+    assert timeline.dump_dropped_total(evs) >= base + 50
+
+    def flusher_threads():
+        return [t for t in threading.enumerate()
+                if t.name == "rtpu-timeline-flush" and t.is_alive()]
+
+    # record_task is the path that lazily starts the flusher
+    timeline.record_task("flusher-probe", time.time(),
+                         time.time() + 1e-4)
+    assert flusher_threads()
+    timeline.stop_flusher()
+    deadline = time.time() + 5
+    while flusher_threads() and time.time() < deadline:
+        time.sleep(0.2)
+    assert not flusher_threads(), "flusher thread survived stop"
+    # a later record_task starts a fresh one (reconnect works)
+    timeline.record_task("again", time.time(), time.time() + 1e-4)
+    assert flusher_threads()
+    timeline.stop_flusher()
