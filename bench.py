@@ -1253,6 +1253,152 @@ def _gameday_bench_main():
     print(json.dumps({"metric": "gameday", **out}), flush=True)
 
 
+def _llm_bench_main():
+    """LLM serving bench (_BENCH_LLM=1): the continuous-batching
+    engine vs the static flush-by-window baseline under a skewed
+    open-loop load (Poisson arrivals, bounded-Pareto output lengths),
+    plus the paged-attention kernel numerics check. One JSON line:
+    tokens/s, p50/p99 time-to-first-token (measured from the SCHEDULED
+    arrival — open-loop discipline), makespan, and the gates the
+    acceptance criteria name: continuous >= 1.5x static tokens/s with
+    better p99 TTFT; paged kernel == whole-kv reference numerics.
+
+    Env: LLM_BENCH_SMOKE=1 shrinks the run (CI smoke);
+    LLM_BENCH_DURATION_S / LLM_BENCH_RPS override the load window.
+
+    The toy adapter emulates model cost (3 ms/step + 0.2 ms/sequence;
+    0.05 ms/prefill token): per-step cost is mostly FIXED, which is
+    exactly the regime where continuous batching wins — a static batch
+    runs its stragglers nearly alone while admitted work waits."""
+    _force_cpu_platform()
+    import random
+    import threading
+
+    from ray_tpu.serve.llm import (EngineConfig, LLMEngine,
+                                   SamplingParams, ToyAdapter)
+
+    smoke = bool(os.environ.get("LLM_BENCH_SMOKE"))
+    # offered tokens/s must exceed the STATIC baseline's capacity
+    # (~210 tok/s at these step costs: a flush-by-window batch runs at
+    # its longest member's length) while staying well under the
+    # continuous engine's (~1.7k tok/s) — that's the regime the gate
+    # measures: same hardware budget, saturation only for the baseline
+    duration = float(os.environ.get("LLM_BENCH_DURATION_S",
+                                    2.5 if smoke else 10.0))
+    rate = float(os.environ.get("LLM_BENCH_RPS",
+                                25.0 if smoke else 40.0))
+    rng = random.Random(1234)
+    arrivals = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration:
+            break
+        plen = rng.randint(8, 32)
+        ntok = max(8, min(128, int(8 * rng.paretovariate(1.2))))
+        arrivals.append((t, [rng.randrange(256)
+                             for _ in range(plen)], ntok))
+
+    def run(policy):
+        eng = LLMEngine(
+            ToyAdapter(step_delay_s=0.003, per_seq_delay_s=0.0002,
+                       per_prefill_token_delay_s=0.00005),
+            EngineConfig(max_running=8, max_waiting=100000,
+                         max_prefill_tokens=256, num_blocks=4096,
+                         block_size=16, max_seq_len=512,
+                         policy=policy))
+        results = []
+        lock = threading.Lock()
+
+        def consume(sched_abs, sid):
+            cur, toks, first = 0, 0, None
+            while True:
+                ch = eng.poll(sid, cur, max_wait_s=30.0)
+                if ch["tokens"] and first is None:
+                    first = time.time()
+                toks += len(ch["tokens"])
+                cur = ch["cursor"]
+                if ch["done"]:
+                    break
+            with lock:
+                results.append(
+                    (max(0.0, (first or time.time()) - sched_abs),
+                     toks))
+
+        threads = []
+        t0 = time.time()
+        for (ta, prompt, ntok) in arrivals:
+            delay = t0 + ta - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            sid = eng.add_request(
+                prompt, SamplingParams(max_new_tokens=ntok))
+            th = threading.Thread(target=consume, args=(t0 + ta, sid))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        makespan = time.time() - t0
+        eng.stop()
+        ttfts = sorted(r[0] for r in results)
+        tokens = sum(r[1] for r in results)
+
+        def q(frac):
+            return round(
+                ttfts[min(len(ttfts) - 1, int(frac * len(ttfts)))]
+                * 1e3, 2)
+
+        return {"tokens": tokens,
+                "makespan_s": round(makespan, 3),
+                "tokens_per_s": round(tokens / makespan, 2),
+                "ttft_p50_ms": q(0.50), "ttft_p99_ms": q(0.99)}
+
+    cont = run("continuous")
+    static = run("static")
+
+    # paged-attention kernel numerics vs the whole-kv reference
+    # (tier-1 re-asserts this; the bench records the number)
+    import numpy as np
+
+    import jax.numpy as jnp
+    from ray_tpu.ops import attention as A
+    r2 = np.random.RandomState(0)
+    B, H, Hkv, D, bs, NB = 3, 8, 2, 16, 8, 4
+    lengths = jnp.asarray([5, 17, 30], jnp.int32)
+    k_pages = jnp.asarray(r2.randn(1 + B * NB, bs, Hkv, D), jnp.float32)
+    v_pages = jnp.asarray(r2.randn(1 + B * NB, bs, Hkv, D), jnp.float32)
+    bt = jnp.asarray(np.arange(1, 1 + B * NB).reshape(B, NB), jnp.int32)
+    qq = jnp.asarray(r2.randn(B, H, D), jnp.float32)
+    ref = A.paged_attention_reference(qq, k_pages, v_pages, bt, lengths)
+    ker = A.paged_attention_decode(qq, k_pages, v_pages, bt, lengths,
+                                   interpret=True)
+    max_err = float(jnp.max(jnp.abs(ref - ker)))
+
+    ratio = round(cont["tokens_per_s"]
+                  / max(static["tokens_per_s"], 1e-9), 2)
+    out = {
+        "metric": "llm_serving",
+        "requests": len(arrivals),
+        "load_window_s": duration,
+        "offered_rps": rate,
+        "continuous_tokens_per_s": cont["tokens_per_s"],
+        "static_tokens_per_s": static["tokens_per_s"],
+        "tokens_per_s_ratio": ratio,
+        "continuous_ttft_p50_ms": cont["ttft_p50_ms"],
+        "continuous_ttft_p99_ms": cont["ttft_p99_ms"],
+        "static_ttft_p50_ms": static["ttft_p50_ms"],
+        "static_ttft_p99_ms": static["ttft_p99_ms"],
+        "continuous_makespan_s": cont["makespan_s"],
+        "static_makespan_s": static["makespan_s"],
+        "paged_kernel_max_err": max_err,
+        "gate_throughput_ok": ratio >= 1.5,
+        "gate_ttft_ok":
+            cont["ttft_p99_ms"] <= static["ttft_p99_ms"],
+        "gate_numerics_ok": max_err < 1e-4,
+    }
+    print(json.dumps(out), flush=True)
+
+
 # ----------------------------------------------------------------- supervise
 
 def _attempt(force_cpu: bool):
@@ -1540,6 +1686,12 @@ def main():
     elif os.environ.get("_BENCH_GAMEDAY"):
         try:
             _gameday_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_LLM"):
+        try:
+            _llm_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

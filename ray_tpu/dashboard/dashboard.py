@@ -415,6 +415,24 @@ def _serve_gauges() -> str:
           "p99 service time over the replica latency reservoirs")
         g("ewma_seconds", dep, m.get("ewma_s") or 0,
           "EWMA service time (slowest replica)")
+        llm = m.get("llm")
+        if not isinstance(llm, dict):
+            continue
+        # LLM engine gauges (serve/llm): the autoscaler's signal set,
+        # exported so capacity decisions are explainable from Grafana
+        g("llm_tokens_per_s", dep, llm.get("tokens_per_s") or 0,
+          "generated tokens/s across replica engines (5s window)")
+        g("llm_kv_occupancy", dep, llm.get("kv_occupancy") or 0,
+          "mean paged-KV pool occupancy across replicas (0..1)")
+        g("llm_running_sequences", dep, llm.get("running") or 0,
+          "sequences in the in-flight decode batches")
+        g("llm_waiting_sequences", dep, llm.get("waiting") or 0,
+          "sequences queued for admission")
+        g("llm_generated_tokens_total", dep,
+          llm.get("generated_tokens_total") or 0,
+          "tokens generated since replica start")
+        g("llm_ttft_p99_seconds", dep, llm.get("ttft_p99_s") or 0,
+          "p99 time-to-first-token (worst replica reservoir)")
     return "\n" + "\n".join(lines) + "\n" if lines else ""
 
 

@@ -101,6 +101,24 @@ class GameDayResult:
 def _bind_app(sc: Scenario, version: int):
     from ray_tpu import serve
     cfg = sc.deployment
+    if cfg.get("workload") == "llm":
+        # the stateful LLM workload (serve/llm): continuous batching +
+        # paged KV + streaming; version rides user_config so a rolling
+        # update replaces replicas exactly like the echo app's
+        from ray_tpu.serve.llm import LLMServer
+        llm = cfg.get("llm") or {}
+        dep = serve.deployment(
+            name=DEPLOYMENT_NAME,
+            num_replicas=int(cfg.get("num_replicas", 2)),
+            max_concurrent_queries=int(
+                cfg.get("max_concurrent_queries", 32)),
+            max_queued_requests=cfg.get("max_queued_requests"),
+            user_config={"v": version},
+            graceful_shutdown_timeout_s=cfg.get(
+                "graceful_shutdown_timeout_s", 20.0))(LLMServer)
+        return dep.bind(llm.get("model", "toy"),
+                        llm.get("model_config"),
+                        llm.get("engine_config"))
     dep = serve.deployment(
         name=DEPLOYMENT_NAME,
         num_replicas=int(cfg.get("num_replicas", 3)),
@@ -319,10 +337,13 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
         # warmup: touch every replica a few times so compile/startup
         # cost never lands inside a measured phase; warmup ids are
         # visible in replica ledgers (harmless to every join)
+        is_llm = scenario.deployment.get("workload") == "llm"
+        warm_payload = ({"tokens": [1, 2, 3], "max_new_tokens": 2}
+                        if is_llm else {"work": 1.0})
         warm = 4 * int(scenario.deployment.get("num_replicas", 3))
         for i in range(warm):
             ray_tpu.get(h.remote(
-                {"work": 1.0},
+                warm_payload,
                 __rtpu_request_id__=f"warmup-{scenario.seed}-{i}"),
                 timeout=60.0)
         time.sleep(1.5)  # task-event flush (0.5 s batches) settles
@@ -416,6 +437,56 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                 return "shed"
             return "failed"
 
+        # ---- LLM workload: streaming sends, counted per token ----
+        # every request opens a stream and consumes it chunk by chunk;
+        # the SLO ledger gets (rid -> tokens received, first-token
+        # time), and reconciliation joins those counts against the
+        # engines' token ledgers. A broken stream is retried WHOLE
+        # (fresh generation, same rid — one logical request) or fails
+        # cleanly; a partially-read stream never counts as ok.
+        import random as _random
+
+        from ray_tpu.serve.exceptions import StreamBrokenError
+        token_counts: Dict[str, int] = {}
+        first_token_at: Dict[str, float] = {}
+        tc_lock = threading.Lock()
+
+        def _llm_payload(arrival: Arrival) -> Dict[str, Any]:
+            # heavy-tail prompt AND output lengths from the arrival's
+            # bounded-Pareto size — deterministic per request id
+            rng = _random.Random(f"llm:{arrival.rid}")
+            plen = max(2, min(48, int(2 + arrival.size * 3)))
+            ntok = max(1, min(40, int(1 + arrival.size * 2)))
+            return {"tokens": [rng.randrange(256) for _ in range(plen)],
+                    "max_new_tokens": ntok}
+
+        def send_llm(arrival: Arrival):
+            payload = _llm_payload(arrival)
+            last: Optional[BaseException] = None
+            for attempt in range(3):
+                stream = router.open_stream(
+                    DEPLOYMENT_NAME, payload, request_id=arrival.rid,
+                    assign_timeout=assign_timeout)
+                n, t_first = 0, None
+                try:
+                    for ch in stream:
+                        if t_first is None and ch.get("tokens"):
+                            t_first = time.time()
+                        n += len(ch.get("tokens") or ())
+                    with tc_lock:
+                        token_counts[arrival.rid] = n
+                        if t_first is not None:
+                            first_token_at[arrival.rid] = t_first
+                    return
+                except StreamBrokenError as e:
+                    last = e
+                    time.sleep(0.3 * (attempt + 1))
+                    router.force_refresh()
+            raise last
+
+        if is_llm:
+            send = send_llm
+
         lg = OpenLoopRunner(schedule, send, classify,
                             max_workers=scenario.max_workers)
         delay = load_t0 - time.time()
@@ -458,6 +529,28 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                 led["live"] = have["live"]
                 by_name[led["replica"]] = led
         replica_ledgers = list(by_name.values())
+
+        # LLM workload: collect every alive engine's metrics + token
+        # ledger (counter-free RPC), merged with the ledgers retired
+        # replicas flushed on drain — the server half of the per-token
+        # join
+        llm_ledgers: List[Dict[str, Any]] = []
+        llm_metrics: Dict[str, Any] = {}
+        if is_llm:
+            for hex_id, handle in _all_alive_replica_handles().items():
+                try:
+                    st = ray_tpu.get(handle.get_llm_state.remote(),
+                                     timeout=10.0)
+                except Exception:
+                    continue
+                if st:
+                    llm_metrics[hex_id] = {
+                        k: v for k, v in st.items()
+                        if k != "token_ledger"}
+                    llm_ledgers.append(
+                        {"replica": hex_id,
+                         "records": st.get("token_ledger") or []})
+            llm_ledgers.extend(store.load_flushed_llm_ledgers())
 
         serve_metrics = _retry(lambda: serve.metrics() or None,
                                timeout=20.0, default={})
@@ -535,6 +628,11 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
             "traces_sampled": sampled,
             "traces_lossy": traces_lossy,
         })
+        if is_llm:
+            with tc_lock:
+                server_view["llm_client_tokens"] = dict(token_counts)
+            server_view["llm_ledgers"] = llm_ledgers
+            server_view["llm_metrics"] = llm_metrics
 
         # ---- grade + publish ----
         # split client sheds: a replica-shed has a server ledger record
@@ -558,6 +656,31 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
             duration_s=schedule.duration_s)
         report["scale"] = scale
         report["setup_s"] = round(load_t0 - t_setup, 2)
+        if is_llm:
+            # per-token SLO accounting: throughput + open-loop TTFT
+            # (first token time measured against the SCHEDULED arrival
+            # — a stalled engine charges every token it delayed)
+            with tc_lock:
+                tok_total = sum(token_counts.values())
+                ttfts = sorted(
+                    max(0.0, t1 - (load_t0 + a.t))
+                    for a in schedule.arrivals
+                    for t1 in (first_token_at.get(a.rid),)
+                    if t1 is not None)
+
+            def _q(vals, frac):
+                return (round(vals[min(len(vals) - 1,
+                                       int(frac * len(vals)))] * 1e3, 3)
+                        if vals else 0.0)
+
+            report["llm"] = {
+                "tokens_total": tok_total,
+                "tokens_per_s": round(
+                    tok_total / max(schedule.duration_s, 1e-9), 3),
+                "requests_with_tokens": len(token_counts),
+                "ttft_p50_ms": _q(ttfts, 0.50),
+                "ttft_p99_ms": _q(ttfts, 0.99),
+            }
         report["actions"] = actions
         report["action_errors"] = action_errors
         report["chaos_fired"] = fired_unique

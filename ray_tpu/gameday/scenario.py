@@ -257,11 +257,64 @@ def _diurnal_soak(seed: int = 128) -> Scenario:
     )
 
 
+def _llm_chat(seed: int = 623) -> Scenario:
+    """LLM serving game day (ROADMAP item 1): streaming chat traffic
+    with heavy-tail prompt AND output lengths (the arrival's bounded-
+    Pareto ``size`` scales both), served by the continuous-batching
+    engine (serve/llm) and consumed token by token — the SLO ledger
+    counts tokens, not just requests, and reconciliation joins the
+    client's per-request token counts against the engines' token
+    ledgers. A rolling update mid-run proves KV-aware drain under
+    load: every in-flight stream finishes on the draining replicas,
+    zero sequences dropped."""
+    return Scenario(
+        "llm-chat", seed=seed,
+        description="streaming LLM chat, heavy-tail lengths, rolling "
+                    "update mid-run; per-token reconciliation, 0 failed",
+        phases=[
+            {"name": "warmup", "duration_s": 2.0, "shape": "steady",
+             "rps": 6},
+            {"name": "chat", "duration_s": 8.0, "shape": "diurnal",
+             "min_rps": 8, "peak_rps": 20},
+            {"name": "cooldown", "duration_s": 2.0, "shape": "steady",
+             "rps": 4},
+        ],
+        actions=[
+            # mid-peak redeploy: draining replicas must finish their
+            # in-flight decodes (KV-aware drain) while new replicas
+            # pick up fresh streams
+            {"kind": "rolling_update", "t_s": 5.0},
+        ],
+        deployment={
+            "workload": "llm",
+            "num_replicas": 2,
+            "max_concurrent_queries": 32,
+            "max_queued_requests": 64,
+            "graceful_shutdown_timeout_s": 20.0,
+            "assign_timeout_s": 15.0,
+            # engine shape: small pool so occupancy moves, tiny
+            # per-step delay so decode time is the workload
+            "llm": {"model": "toy",
+                    "model_config": {"per_seq_delay_s": 0.0005,
+                                     "step_delay_s": 0.001},
+                    "engine_config": {"max_running": 8,
+                                      "max_waiting": 64,
+                                      "num_blocks": 256,
+                                      "block_size": 16,
+                                      "max_seq_len": 512}},
+        },
+        slo={"availability_target": 0.999,
+             "latency_target_ms": 4000.0},
+        max_workers=48,
+    )
+
+
 _BUILTIN = {
     "flagship": _flagship,
     "flash-crowd": _flash_crowd,
     "replica-storm": _replica_storm,
     "diurnal-soak": _diurnal_soak,
+    "llm-chat": _llm_chat,
 }
 
 

@@ -27,6 +27,7 @@ logger = logging.getLogger("ray_tpu.gameday.store")
 PREFIX = "@gameday/"
 REPORT_KEY = PREFIX + "report"
 LEDGER_PREFIX = PREFIX + "ledger/"
+LLM_LEDGER_PREFIX = PREFIX + "llm-ledger/"
 
 
 def _gcs_call(method: str, payload: Dict[str, Any], timeout: float = 10.0):
@@ -102,10 +103,46 @@ def load_flushed_ledgers() -> List[Dict[str, Any]]:
     return out
 
 
+def flush_llm_ledger(replica_name: str, records: List[Any]) -> bool:
+    """serve/llm: a replica retired by a rolling update flushes its
+    per-request token ledger ((request_id, n_tokens, finish_reason)
+    rows) so the per-token reconciliation join survives the drain."""
+    if not records:
+        return True
+    try:
+        _gcs_call("kv_put", {
+            "key": LLM_LEDGER_PREFIX + replica_name,
+            "value": json.dumps({"replica": replica_name,
+                                 "records": records}).encode()})
+        return True
+    except Exception:
+        logger.warning("gameday: llm ledger flush failed for %r",
+                       replica_name, exc_info=True)
+        return False
+
+
+def load_flushed_llm_ledgers() -> List[Dict[str, Any]]:
+    try:
+        reply = _gcs_call("kv_get_prefix",
+                          {"prefix": LLM_LEDGER_PREFIX}, timeout=30.0)
+    except Exception:
+        return []
+    out = []
+    for _key, value in reply.get("items") or []:
+        try:
+            if isinstance(value, str):
+                value = value.encode()
+            out.append(json.loads(value))
+        except Exception:
+            continue
+    return out
+
+
 def clear_ledgers() -> None:
     """Scenario start: drop stale ledgers so one game day never joins
     against another's records."""
-    try:
-        _gcs_call("kv_del", {"key": LEDGER_PREFIX, "prefix": True})
-    except Exception:
-        pass
+    for prefix in (LEDGER_PREFIX, LLM_LEDGER_PREFIX):
+        try:
+            _gcs_call("kv_del", {"key": prefix, "prefix": True})
+        except Exception:
+            pass

@@ -58,7 +58,8 @@ class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, kv_cache=None,
+                 seq_lengths=None, valid=None):
         cfg = self.config
         B, S, E = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -68,6 +69,19 @@ class CausalSelfAttention(nn.Module):
         def heads(t):  # [B,S,E] -> [B,H,S,D]
             return t.reshape(B, S, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
 
+        if kv_cache is not None:
+            # incremental decode (docs/LLM_SERVING.md): append this
+            # call's kv into the cache (contiguous or paged) and attend
+            # the S new queries against the whole cached prefix
+            from ray_tpu.ops.attention import cached_attention
+            tok = lambda t: t.reshape(B, S, cfg.n_head, head_dim)  # noqa: E731
+            y, new_cache = cached_attention(
+                tok(q), tok(k), tok(v), kv_cache, seq_lengths,
+                valid=valid)
+            y = y.reshape(B, S, E)
+            y = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(y)
+            return (nn.Dropout(cfg.dropout)(y, deterministic),
+                    new_cache)
         q, k, v = heads(q), heads(k), heads(v)
         if cfg.attention_backend == "ring":
             from ray_tpu.ops.ring_attention import ring_attention
@@ -100,8 +114,19 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, kv_cache=None,
+                 seq_lengths=None, valid=None):
         cfg = self.config
+        if kv_cache is not None:
+            y, new_cache = CausalSelfAttention(cfg, name="attn")(
+                nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x),
+                deterministic, kv_cache=kv_cache,
+                seq_lengths=seq_lengths, valid=valid)
+            x = x + y
+            x = x + MLP(cfg, name="mlp")(
+                nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x),
+                deterministic)
+            return x, new_cache
         x = x + CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x), deterministic)
         x = x + MLP(cfg, name="mlp")(
@@ -114,23 +139,55 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
-                 positions: Optional[jnp.ndarray] = None):
+                 positions: Optional[jnp.ndarray] = None,
+                 kv_cache=None, seq_lengths=None, valid=None):
+        """Full forward (logits) — or, with ``kv_cache``, one
+        incremental step: the S tokens of ``input_ids`` are appended to
+        per-layer caches (``init_kv_cache`` / the serve LLM engine's
+        paged pool) holding ``seq_lengths`` prior tokens, and the
+        return value is ``(logits, new_kv_cache)``. Prefill is the
+        ``seq_lengths == 0`` case; decode passes one token at a time.
+        ``valid`` marks real tokens when S is padded to a bucket."""
         cfg = self.config
         B, S = input_ids.shape
+        incremental = kv_cache is not None
         if positions is None:
-            positions = jnp.arange(S)[None, :]
+            if incremental:
+                positions = seq_lengths[:, None] + jnp.arange(S)[None, :]
+                if valid is not None:
+                    positions = jnp.where(valid, positions, 0)
+            else:
+                positions = jnp.arange(S)[None, :]
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
                        dtype=cfg.dtype, name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd,
                        dtype=cfg.dtype, name="wpe")
         x = wte(input_ids) + wpe(positions)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        new_caches = []
         for i in range(cfg.n_layer):
-            x = Block(cfg, name=f"h_{i}")(x, deterministic)
+            if incremental:
+                x, c = Block(cfg, name=f"h_{i}")(
+                    x, deterministic, kv_cache=kv_cache[i],
+                    seq_lengths=seq_lengths, valid=valid)
+                new_caches.append(c)
+            else:
+                x = Block(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # weight-tied LM head
         logits = wte.attend(x.astype(jnp.float32))
-        return logits
+        return (logits, new_caches) if incremental else logits
+
+
+def init_kv_cache(cfg: GPT2Config, batch_size: int, max_len: int):
+    """Per-layer contiguous KV caches for incremental decode
+    ([B, S_max, H, D] token-major — the layout ops.attention's cached
+    paths share with the paged pool)."""
+    hd = cfg.n_embd // cfg.n_head
+    shape = (batch_size, max_len, cfg.n_head, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layer)]
 
 
 def causal_lm_loss(logits, labels, ignore_index: int = -100):

@@ -92,13 +92,23 @@ def rope_frequencies(head_dim: int, max_seq_len: int,
                        jnp.float32)             # [S, D/2, 2]
 
 
-def apply_rope(x: jnp.ndarray, freqs: jnp.ndarray) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, freqs: jnp.ndarray,
+               positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """x: [B,H,S,D]; rotate (first-half, second-half) feature pairs by
     position angle — the rotate-half convention HF Llama checkpoints
-    are permuted for, so imported weights work unmodified."""
+    are permuted for, so imported weights work unmodified.
+
+    ``positions`` ([B, S] int32, optional) gives each token its
+    absolute position explicitly — incremental decode rotates the new
+    tokens by their true offsets instead of 0..S-1."""
     B, H, S, D = x.shape
-    cos = freqs[:S, :, 0][None, None]           # [1,1,S,D/2]
-    sin = freqs[:S, :, 1][None, None]
+    if positions is None:
+        cos = freqs[:S, :, 0][None, None]       # [1,1,S,D/2]
+        sin = freqs[:S, :, 1][None, None]
+    else:
+        per = jnp.take(freqs, positions, axis=0)  # [B,S,D/2,2]
+        cos = per[..., 0][:, None]              # [B,1,S,D/2]
+        sin = per[..., 1][:, None]
     x1, x2 = x[..., :D // 2], x[..., D // 2:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     r1 = xf1 * cos - xf2 * sin
@@ -110,7 +120,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, freqs):
+    def __call__(self, x, freqs, kv_cache=None, seq_lengths=None,
+                 valid=None, positions=None):
         cfg = self.config
         B, S, E = x.shape
         hd = cfg.head_dim
@@ -123,8 +134,19 @@ class LlamaAttention(nn.Module):
         q = q.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        q = apply_rope(q, freqs)
-        k = apply_rope(k, freqs)
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        if kv_cache is not None:
+            # incremental decode: cache holds the UN-replicated kv
+            # heads (GQA broadcast happens inside decode_attention)
+            from ray_tpu.ops.attention import cached_attention
+            y, new_cache = cached_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), kv_cache, seq_lengths,
+                valid=valid)
+            y = y.reshape(B, S, cfg.n_heads * hd)
+            return (nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                             name="wo")(y), new_cache)
         if cfg.n_kv_heads != cfg.n_heads:
             # grouped-query: broadcast each kv head over its query group
             rep = cfg.n_heads // cfg.n_kv_heads
@@ -163,8 +185,18 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, freqs):
+    def __call__(self, x, freqs, kv_cache=None, seq_lengths=None,
+                 valid=None, positions=None):
         cfg = self.config
+        if kv_cache is not None:
+            y, new_cache = LlamaAttention(cfg, name="attention")(
+                RMSNorm(cfg.norm_eps, name="attention_norm")(x), freqs,
+                kv_cache=kv_cache, seq_lengths=seq_lengths,
+                valid=valid, positions=positions)
+            x = x + y
+            x = x + LlamaMLP(cfg, name="feed_forward")(
+                RMSNorm(cfg.norm_eps, name="ffn_norm")(x))
+            return x, new_cache
         x = x + LlamaAttention(cfg, name="attention")(
             RMSNorm(cfg.norm_eps, name="attention_norm")(x), freqs)
         x = x + LlamaMLP(cfg, name="feed_forward")(
@@ -177,18 +209,48 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, kv_cache=None, seq_lengths=None,
+                 valid=None):
+        """Full forward — or, with ``kv_cache``, one incremental step
+        (prefill at ``seq_lengths == 0``, then single-token decodes):
+        tokens are appended to the per-layer caches and rotated by
+        their TRUE absolute positions; returns ``(logits, new_cache)``.
+        ``valid`` marks real tokens when S is padded to a bucket."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim,
                      dtype=cfg.dtype, name="tok_embeddings")(input_ids)
         freqs = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                  cfg.rope_theta)
+        incremental = kv_cache is not None
+        positions = None
+        if incremental:
+            S = input_ids.shape[1]
+            positions = seq_lengths[:, None] + jnp.arange(S)[None, :]
+            if valid is not None:
+                positions = jnp.where(valid, positions, 0)
+        new_caches = []
         for i in range(cfg.n_layers):
-            x = LlamaBlock(cfg, name=f"layers_{i}")(x, freqs)
+            if incremental:
+                x, c = LlamaBlock(cfg, name=f"layers_{i}")(
+                    x, freqs, kv_cache=kv_cache[i],
+                    seq_lengths=seq_lengths, valid=valid,
+                    positions=positions)
+                new_caches.append(c)
+            else:
+                x = LlamaBlock(cfg, name=f"layers_{i}")(x, freqs)
         x = RMSNorm(cfg.norm_eps, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False,
                           dtype=jnp.float32, name="output")(x)
-        return logits
+        return (logits, new_caches) if incremental else logits
+
+
+def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+    """Per-layer contiguous KV caches ([B, S_max, Hkv, D] token-major,
+    GQA: the un-replicated kv heads) for incremental decode."""
+    shape = (batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
 
 
 def causal_lm_loss(logits, input_ids):

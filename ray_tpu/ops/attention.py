@@ -682,3 +682,286 @@ def flash_attention(q, k, v, *, causal: bool = False,
         _debug_check_logits(q, k)
     return _flash_attention(q, k, v, 1.0, causal, block_q, block_k,
                             interpret, exact)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode + paged KV cache (LLM serving, docs/LLM_SERVING.md)
+#
+# Training attention above recomputes every key/value each step; online
+# inference must not. The serve LLM engine keeps KV in fixed-size BLOCKS
+# (a paged cache, vLLM-style): per sequence a block table maps logical
+# token positions to physical pages, so sequences grow without
+# contiguous reallocation and freed pages are reusable immediately.
+#
+# Layouts (chosen so a scatter/gather is one advanced-index op):
+#   contiguous cache   k/v: [B, S_max, Hkv, D]
+#   paged cache        k/v pages: [P, bs, Hkv, D]; block_tables [B, NB]
+#   lengths            [B] int32 — valid cache entries per sequence
+#
+# Three compute paths, all numerically equivalent (tier-1 gated in
+# tests/test_llm_serving.py):
+#   decode_attention            contiguous masked reference (XLA, CPU ok)
+#   paged_attention_reference   gather pages -> decode_attention
+#   paged_attention_decode      Pallas kernel: scalar-prefetched block
+#                               tables index pages straight from HBM,
+#                               flash-style online softmax per block —
+#                               the cache is never materialized
+#                               contiguously (interpret=True on CPU)
+
+
+def _repeat_kv(k, rep: int, axis: int = 1):
+    """Broadcast each kv head over its query group (GQA)."""
+    return k if rep == 1 else jnp.repeat(k, rep, axis=axis)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, *,
+                     sm_scale: Optional[float] = None,
+                     q_positions=None):
+    """Attention of new-token queries against a (padded) KV cache.
+
+    q: [B, H, S_new, D] — the S_new newest tokens' queries; the cache
+    already contains their keys/values (positions
+    ``lengths - S_new .. lengths - 1``).
+    k_cache/v_cache: [B, S_max, Hkv, D]; lengths: [B] int32 — valid
+    entries INCLUDING the new tokens. Causal within the new tokens,
+    full visibility over the prefix, masked past ``lengths``. GQA when
+    Hkv < H (H must be a multiple of Hkv). ``q_positions`` ([B, S_new]
+    int32, optional) overrides each query row's absolute position —
+    right-padded prefill passes the real positions (and -1 for padding
+    rows, whose output is discarded). Returns [B, H, S_new, D].
+    """
+    B, H, S_new, D = q.shape
+    Hkv = k_cache.shape[2]
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    k = _repeat_kv(k_cache.transpose(0, 2, 1, 3), H // Hkv)  # [B,H,S,D]
+    v = _repeat_kv(v_cache.transpose(0, 2, 1, 3), H // Hkv)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    S_max = k_cache.shape[1]
+    # query i (0-based among the new tokens) sits at absolute position
+    # lengths - S_new + i and may attend to absolute positions <= its own
+    if q_positions is None:
+        q_positions = (lengths[:, None] - S_new) + \
+            jnp.arange(S_new)[None, :]                     # [B,S_new]
+    q_pos = q_positions[..., None]                         # [B,S_new,1]
+    k_pos = jnp.arange(S_max)[None, None, :]               # [1,1,S_max]
+    mask = (k_pos <= q_pos)[:, None]                       # [B,1,S_new,S_max]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def append_kv_pages(k_new, v_new, k_pages, v_pages, block_tables,
+                    lengths, valid=None):
+    """Scatter new keys/values into their pages.
+
+    k_new/v_new: [B, S, Hkv, D] written at logical positions
+    ``lengths .. lengths + S - 1`` of each sequence; ``valid`` ([B, S]
+    bool, optional) routes padding tokens to the reserved null page 0
+    instead (batch/length bucketing for jit). Returns updated
+    (k_pages, v_pages). Distinct sequences own distinct pages, so the
+    scatter indices never collide except in the null page (scratch).
+    """
+    B, S = k_new.shape[:2]
+    bs = k_pages.shape[1]
+    pos = lengths[:, None] + jnp.arange(S)[None, :]        # [B, S]
+    page = jnp.take_along_axis(block_tables, pos // bs, axis=1)
+    slot = pos % bs
+    if valid is not None:
+        page = jnp.where(valid, page, 0)
+        slot = jnp.where(valid, slot, 0)
+    k_pages = k_pages.at[page, slot].set(k_new)
+    v_pages = v_pages.at[page, slot].set(v_new)
+    return k_pages, v_pages
+
+
+def paged_gather(pages, block_tables):
+    """Pages -> per-sequence (padded) contiguous cache:
+    [P, bs, Hkv, D] + [B, NB] -> [B, NB*bs, Hkv, D]."""
+    B, NB = block_tables.shape
+    bs = pages.shape[1]
+    out = pages[block_tables]                              # [B,NB,bs,Hkv,D]
+    return out.reshape(B, NB * bs, *pages.shape[2:])
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              lengths, *,
+                              sm_scale: Optional[float] = None):
+    """Single-token decode against the paged cache, via gather (the
+    correctness baseline for the Pallas kernel and the CPU fallback).
+
+    q: [B, H, D] (one query token per sequence); returns [B, H, D].
+    """
+    out = decode_attention(q[:, :, None, :],
+                           paged_gather(k_pages, block_tables),
+                           paged_gather(v_pages, block_tables),
+                           lengths, sm_scale=sm_scale)
+    return out[:, :, 0, :]
+
+
+def _paged_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_size,
+                         num_blocks):
+    """One (sequence, kv-head, page) grid step of paged flash decode.
+
+    The page refs were DMA'd by the scalar-prefetched index map (the
+    block table picks the physical page per grid step), so the body is
+    plain flash: one [G, bs] dot, online softmax, [G, D] accumulate.
+    Fully-masked pages (past the sequence length) contribute zero
+    because masked logits are a large-but-finite negative, never -inf.
+    """
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[:]                                  # [G, D]
+    k = k_ref[:]                                  # [bs, D]
+    v = v_ref[:]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(pos < len_ref[b], s, _NEG_INF)
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:], l_ref[:] = m_new, l_new
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_blocks - 1)
+    def _():
+        o_ref[:] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
+                           *, sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Pallas paged-attention decode: q [B, H, D] against block-table-
+    addressed pages, without gathering the cache into contiguous HBM.
+
+    Grid (B, Hkv, NB); the block table + lengths ride scalar prefetch
+    so each grid step's BlockSpec index map DMAs exactly the page it
+    needs (pallas_guide: PrefetchScalarGridSpec). Off-TPU (and not
+    ``interpret``) this falls back to the gather reference — numerics
+    are identical (gated in tests), so callers never branch.
+
+    GQA note: the G = H // Hkv query heads of one kv head form the
+    kernel's [G, D] q block; small G under-fills TPU sublanes — pad
+    query heads toward G >= 8 for peak MXU use on real hardware.
+    """
+    if interpret is None:
+        interpret = False
+        if not _use_pallas():
+            return paged_attention_reference(
+                q, k_pages, v_pages, block_tables, lengths,
+                sm_scale=sm_scale)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    P, bs, Hkv, _ = k_pages.shape
+    NB = block_tables.shape[1]
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    qf = (q * sm_scale).astype(q.dtype).reshape(B, Hkv, G, D)
+    kernel = functools.partial(_paged_decode_kernel, block_size=bs,
+                               num_blocks=NB)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, NB),
+        in_specs=[
+            pl.BlockSpec((None, None, G, D),
+                         lambda b, h, j, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((None, bs, None, D),
+                         lambda b, h, j, bt, ln: (bt[b, j], 0, h, 0)),
+            pl.BlockSpec((None, bs, None, D),
+                         lambda b, h, j, bt, ln: (bt[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, G, D),
+                               lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qf, k_pages, v_pages)
+    return out.reshape(B, H, D)
+
+
+def cached_attention(q, k_new, v_new, cache, seq_lengths, *,
+                     sm_scale: Optional[float] = None, valid=None):
+    """Shared incremental-attention step for the model decode paths
+    (models/gpt2.py, models/llama.py).
+
+    q/k_new/v_new: [B, S, H|Hkv, D] projections of the S newest tokens
+    (q head-major is the CALLER's concern — here everything is token-
+    major, matching the cache layouts). ``cache`` is one layer's cache:
+
+      {"k": [B,S_max,Hkv,D], "v": ...}                    contiguous
+      {"k_pages": [P,bs,Hkv,D], "v_pages": ...,
+       "block_tables": [B,NB]}                            paged
+
+    ``seq_lengths`` [B] counts valid cache entries BEFORE this call
+    (i.e. the prefix length); ``valid`` ([B, S] bool, optional) marks
+    real tokens when the caller padded S to a bucket — padding kv is
+    routed to the paged cache's null page and masked out of attention
+    by the lengths. Appends the new kv, attends causally, and returns
+    (out [B, S, H, D], updated cache dict).
+    """
+    B, S = q.shape[:2]
+    q_positions = None
+    if valid is not None:
+        new_len = seq_lengths + jnp.sum(valid.astype(jnp.int32), axis=1)
+        # right-padding: real token i sits at absolute seq_lengths + i;
+        # padding rows attend to nothing real (position -1)
+        q_positions = jnp.where(
+            valid, seq_lengths[:, None] + jnp.arange(S)[None, :], -1)
+    else:
+        new_len = seq_lengths + S
+    if "k_pages" in cache:
+        k_pages, v_pages = append_kv_pages(
+            k_new, v_new, cache["k_pages"], cache["v_pages"],
+            cache["block_tables"], seq_lengths, valid=valid)
+        out = decode_attention(
+            q.transpose(0, 2, 1, 3),
+            paged_gather(k_pages, cache["block_tables"]),
+            paged_gather(v_pages, cache["block_tables"]),
+            new_len, sm_scale=sm_scale, q_positions=q_positions)
+        new_cache = dict(cache, k_pages=k_pages, v_pages=v_pages)
+    else:
+        pos = seq_lengths[:, None] + jnp.arange(S)[None, :]
+        bidx = jnp.arange(B)[:, None]
+        if valid is not None:
+            # padded tokens must not clobber cache slots a later real
+            # token will own: clamp their write position in place
+            vm = valid[..., None, None]
+            k_new = jnp.where(vm, k_new, cache["k"][bidx, pos])
+            v_new = jnp.where(vm, v_new, cache["v"][bidx, pos])
+        k_cache = cache["k"].at[bidx, pos].set(k_new)
+        v_cache = cache["v"].at[bidx, pos].set(v_new)
+        out = decode_attention(q.transpose(0, 2, 1, 3), k_cache,
+                               v_cache, new_len, sm_scale=sm_scale,
+                               q_positions=q_positions)
+        new_cache = dict(cache, k=k_cache, v=v_cache)
+    return out.transpose(0, 2, 1, 3), new_cache

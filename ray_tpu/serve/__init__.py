@@ -24,4 +24,14 @@ __all__ = [
     "DeploymentHandle", "batch", "AutoscalingConfig",
     "APIRouter", "ingress",
     "ReplicaOverloadedError", "BatchSubmitTimeoutError",
+    "llm",
 ]
+
+
+def __getattr__(name):
+    # serve.llm loads lazily: the LLM engine (docs/LLM_SERVING.md)
+    # pulls in numpy/jax paths that plain serve users shouldn't pay for
+    if name == "llm":
+        import importlib
+        return importlib.import_module("ray_tpu.serve.llm")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

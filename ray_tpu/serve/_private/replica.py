@@ -177,10 +177,18 @@ class ReplicaActor:
             # chaos injection point: "kill" at the N-th request this
             # replica accepted (method filter = deployment name)
             chaos.hit("serve.replica.request", self.deployment_name)
+        # stream-poll methods a stateful callable lists as drain-exempt
+        # (serve/llm: __llm_next__) stay answerable while draining — an
+        # in-flight stream must read its remaining tokens before the
+        # controller's idle-kill (KV-aware drain, docs/LLM_SERVING.md)
+        drain_exempt = (not self._is_function
+                        and method_name in getattr(
+                            self.callable, "__serve_drain_exempt__", ()))
         with self._ongoing_lock:
             in_flight = self._ongoing + self._queued
             limit = self._max_concurrent + self._max_queued
-            if self._draining or in_flight >= limit:
+            if (self._draining and not drain_exempt) \
+                    or in_flight >= limit:
                 # a draining replica finishes what it has but takes no
                 # new work; a full replica sheds — both retriable, so
                 # the router re-routes to a replica still in the
@@ -237,6 +245,11 @@ class ReplicaActor:
                 target = self.callable
             else:
                 target = getattr(self.callable, method_name or "__call__")
+            if rid is not None and not self._is_function and getattr(
+                    self.callable, "__serve_wants_request_id__", False):
+                # stateful callables (serve/llm) opt back into seeing
+                # the request id (per-request token ledger, spans)
+                kwargs = dict(kwargs, **{REQUEST_ID_KWARG: rid})
             return target(*args, **kwargs)
         except Exception:
             outcome = "error"
@@ -271,9 +284,15 @@ class ReplicaActor:
     def get_load(self) -> Dict[str, Any]:
         """Cheap telemetry snapshot: what the router's power-of-two-
         choices scoring consumes (piggybacked + long-poll refreshed),
-        and what the controller's drain poll watches reach zero."""
+        and what the controller's drain poll watches reach zero.
+
+        A stateful callable (serve/llm) merges its own load via the
+        ``__serve_load__`` hook: its in-flight sequences add to
+        ``queue_len`` — so the drain poll waits for decodes to finish
+        and p2c sees decode pressure — and its ``llm`` metrics ride
+        the controller telemetry into the autoscaler + /metrics."""
         with self._ongoing_lock:
-            return {
+            out = {
                 "queue_len": self._ongoing + self._queued,
                 "ewma_s": self._ewma_s,
                 "shed": self._total_shed,
@@ -283,6 +302,16 @@ class ReplicaActor:
                 "p99_s": self._quantile(0.99),
                 "ts": time.time(),
             }
+        if not self._is_function and hasattr(self.callable,
+                                             "__serve_load__"):
+            try:
+                extra = self.callable.__serve_load__() or {}
+                out["queue_len"] += int(extra.get("queue_len_extra", 0))
+                if extra.get("llm") is not None:
+                    out["llm"] = extra["llm"]
+            except Exception:
+                pass
+        return out
 
     def _quantile(self, q: float) -> float:
         """Tail quantile over the bounded reservoir (caller holds the
@@ -292,6 +321,18 @@ class ReplicaActor:
         vals = sorted(self._lat_ring)
         idx = min(len(vals) - 1, int(q * len(vals)))
         return vals[idx]
+
+    def get_llm_state(self) -> Optional[Dict[str, Any]]:
+        """LLM engine metrics + token ledger (serve/llm), read OUTSIDE
+        the request path — collection must not move the request
+        counters the game-day reconciliation compares."""
+        if not self._is_function and hasattr(self.callable,
+                                             "__llm_metrics__"):
+            try:
+                return self.callable.__llm_metrics__()
+            except Exception:
+                return None
+        return None
 
     def get_replica_metadata(self) -> Dict[str, Any]:
         """Identity for controller re-adoption (orphan sweep after a
@@ -314,9 +355,19 @@ class ReplicaActor:
 
     def prepare_drain(self) -> str:
         """Graceful-drain step 2 (step 1 removed us from the route
-        table): stop accepting new requests; in-flight ones finish."""
+        table): stop accepting new requests; in-flight ones finish.
+        Stateful callables get the ``__serve_prepare_drain__`` hook so
+        their own admission (the LLM engine's) closes too, while their
+        in-flight work (decoding sequences) runs to completion."""
         with self._ongoing_lock:
             self._draining = True
+        if not self._is_function and hasattr(self.callable,
+                                             "__serve_prepare_drain__"):
+            try:
+                self.callable.__serve_prepare_drain__()
+            except Exception:
+                import traceback
+                traceback.print_exc()
         return "ok"
 
     def get_metrics(self) -> Dict[str, Any]:
@@ -345,6 +396,15 @@ class ReplicaActor:
         return "ok"
 
     def prepare_for_shutdown(self):
+        # stateful callables flush their own state first (serve/llm:
+        # the per-request token ledger a rolling update must not lose)
+        if not self._is_function and hasattr(
+                self.callable, "__serve_prepare_shutdown__"):
+            try:
+                self.callable.__serve_prepare_shutdown__(
+                    self.replica_name)
+            except Exception:
+                pass
         # drain this process's task-event ring synchronously: the
         # controller kills us right after this RPC returns, and the
         # FINISHED events of our last requests (≤0.5 s of batching)

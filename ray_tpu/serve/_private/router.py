@@ -490,6 +490,167 @@ class Router:
             return out
         raise last_err
 
+    # ------------------------------------------------------- streaming
+
+    def open_stream(self, deployment_name: str, payload: Any, *,
+                    request_id: Optional[str] = None,
+                    assign_timeout: float = 30.0,
+                    open_timeout: float = 30.0,
+                    overload_retries: Optional[int] = None,
+                    trace_parent: Optional[Dict[str, str]] = None
+                    ) -> "ReplicaStream":
+        """Start a token stream (serve/llm): pick a replica with the
+        same load-aware admission as a unary request, call its
+        ``__llm_open__``, and return a ``ReplicaStream`` pinned to that
+        replica (sequence state is replica-local — every subsequent
+        poll MUST go to the same one). A shed open retries on other
+        replicas exactly like ``execute_request``; the router in-flight
+        slot is held only for the open call — once the engine admitted
+        the sequence, ITS admission (waiting queue + KV pool) is the
+        backpressure, and polls are cheap cursor reads.
+
+        Tracing: the ``serve.request`` root span stays open until the
+        stream finishes, so the trace covers the full generation, not
+        just the admission RPC."""
+        if overload_retries is None:
+            try:
+                overload_retries = int(os.environ.get(
+                    "RTPU_SERVE_OVERLOAD_RETRIES", 3))
+            except ValueError:
+                overload_retries = 3
+        kwargs: Dict[str, Any] = {}
+        if request_id is not None:
+            from ray_tpu.serve._private.replica import REQUEST_ID_KWARG
+            kwargs[REQUEST_ID_KWARG] = request_id
+        root = None
+        sampled = False
+        if tracing.enabled():
+            from ray_tpu.serve._private.replica import TRACE_CTX_KWARG
+            root = tracing.Span(
+                (trace_parent or {}).get("trace_id") or request_id
+                or tracing.new_trace_id(),
+                f"serve.request:{deployment_name}",
+                parent_span_id=(trace_parent or {}).get("span_id"),
+                kind="serve.request", phase="transfer",
+                attrs={"deployment": deployment_name,
+                       "request_id": request_id, "streaming": True})
+            sampled = tracing.sampled(root.trace_id)
+            if sampled:
+                kwargs[TRACE_CTX_KWARG] = root.child_ctx()
+        rs = self.replica_set(deployment_name)
+        exclude: Set[str] = set()
+        last_err: Optional[BaseException] = None
+        try:
+            for _ in range(max(1, overload_retries + 1)):
+                replica = rs.assign(timeout=assign_timeout,
+                                    exclude=exclude)
+                ref = _call_under_span(
+                    root if sampled else None,
+                    lambda: replica.handle_request_with_load.remote(
+                        "__llm_open__", (payload,), kwargs))
+                try:
+                    out = ray_tpu.get(ref, timeout=open_timeout)
+                except Exception as e:
+                    if is_overload_error(e):
+                        exclude.add(replica._id_hex)
+                        rs.record_report(replica._id_hex,
+                                         queue_len=float("inf"))
+                        last_err = e
+                        continue
+                    raise
+                finally:
+                    rs.release(replica)
+                if isinstance(out, dict) and "__serve_result__" in out:
+                    load = out.get("__serve_load__")
+                    if isinstance(load, dict):
+                        rs.record_report(replica._id_hex,
+                                         load.get("queue_len", 0),
+                                         load.get("ewma_s", 0.0),
+                                         load.get("ts"))
+                    out = out["__serve_result__"]
+                return ReplicaStream(deployment_name, replica,
+                                     out["stream_id"], root)
+            raise last_err
+        except BaseException:
+            if root is not None:
+                root.finish("error")
+            raise
+
     def stop(self):
         self._poller.stop()
         self._load_poller.stop()
+
+
+class ReplicaStream:
+    """A token stream pinned to one replica (serve/llm sequences are
+    replica-local state). Iterating yields chunk dicts
+    ``{"tokens", "text"?, "cursor", "done", ...}``; the final chunk has
+    ``done=True`` and a ``finish_reason``. A replica death mid-stream
+    raises ``StreamBrokenError`` carrying the progress so far — the
+    caller retries the WHOLE request or fails cleanly; a stream is
+    never silently truncated."""
+
+    def __init__(self, deployment_name: str, replica, stream_id: str,
+                 root_span=None):
+        self.deployment_name = deployment_name
+        self.replica = replica
+        self.stream_id = stream_id
+        self.cursor = 0
+        self.done = False
+        self.finish_reason: Optional[str] = None
+        self._root = root_span
+
+    def _finish_span(self, status: str = "ok"):
+        if self._root is not None:
+            self._root.finish(status)
+            self._root = None
+
+    def next_chunk(self, max_wait_s: float = 10.0,
+                   get_timeout: float = 30.0) -> Dict[str, Any]:
+        """One cursor poll; returns the next chunk (possibly empty on
+        an idle wait timeout — call again)."""
+        if self.done:
+            return {"tokens": [], "cursor": self.cursor, "done": True,
+                    "finish_reason": self.finish_reason}
+        try:
+            chunk = ray_tpu.get(
+                self.replica.handle_request.remote(
+                    "__llm_next__", (self.stream_id, self.cursor,
+                                     max_wait_s), {}),
+                timeout=get_timeout)
+        except BaseException as e:
+            self._finish_span("error")
+            if isinstance(e, (rexc.ActorDiedError,
+                              rexc.ActorUnavailableError, KeyError,
+                              rexc.TaskError)):
+                from ray_tpu.serve.exceptions import StreamBrokenError
+                raise StreamBrokenError(
+                    self.deployment_name, self.cursor,
+                    f"{type(e).__name__}: {e}".split("\n")[0]) from e
+            raise
+        self.cursor = chunk.get("cursor", self.cursor)
+        if chunk.get("done"):
+            self.done = True
+            self.finish_reason = chunk.get("finish_reason")
+            if chunk.get("error"):
+                self._finish_span("error")
+                from ray_tpu.serve.exceptions import StreamBrokenError
+                raise StreamBrokenError(self.deployment_name,
+                                        self.cursor, chunk["error"])
+            self._finish_span("ok")
+        return chunk
+
+    def __iter__(self):
+        while not self.done:
+            chunk = self.next_chunk()
+            if chunk.get("tokens") or chunk.get("done"):
+                yield chunk
+
+    def cancel(self):
+        self._finish_span("error")
+        try:
+            self.replica.handle_request.remote(
+                "__llm_cancel__", (self.stream_id,), {})
+        except Exception:
+            pass
+        self.done = True

@@ -85,6 +85,38 @@ def _health_timeout_s() -> float:
     return _env_float("RTPU_SERVE_HEALTH_TIMEOUT_S", 10.0)
 
 
+def _aggregate_llm(per_replica: Dict[str, Any]
+                   ) -> Optional[Dict[str, Any]]:
+    """Fold the per-replica ``llm`` load rows (serve/llm engine
+    telemetry riding ``ReplicaActor.get_load``) into one deployment-
+    level signal set: summed throughput/sequence counts, MEAN KV
+    occupancy (each replica owns an equal pool). None when no replica
+    reports LLM metrics (stateless deployments stay on queue depth)."""
+    rows = [v["llm"] for v in per_replica.values()
+            if isinstance(v, dict) and isinstance(v.get("llm"), dict)]
+    if not rows:
+        return None
+    n = len(rows)
+    return {
+        "tokens_per_s": sum(r.get("tokens_per_s", 0.0) for r in rows),
+        "kv_occupancy": sum(r.get("kv_occupancy", 0.0)
+                            for r in rows) / n,
+        "running": sum(r.get("running", 0) for r in rows),
+        "waiting": sum(r.get("waiting", 0) for r in rows),
+        "generated_tokens_total": sum(
+            r.get("generated_tokens_total", 0) for r in rows),
+        "finished_total": sum(r.get("finished_total", 0)
+                              for r in rows),
+        "kv_blocks_used": sum(r.get("kv_blocks_used", 0)
+                              for r in rows),
+        "kv_blocks_total": sum(r.get("kv_blocks_total", 0)
+                               for r in rows),
+        "ttft_p99_s": max((r.get("ttft_p99_s", 0.0) for r in rows),
+                          default=0.0),
+        "replicas_reporting": n,
+    }
+
+
 class _DeploymentInfo:
     def __init__(self, config: Dict[str, Any]):
         self.config = config
@@ -501,6 +533,9 @@ class ServeController:
                 "p99_s": round(p99, 6),
                 "ewma_s": round(ewma, 6),
             }
+            llm = _aggregate_llm(loads)
+            if llm is not None:
+                out[name]["llm"] = llm
         return out
 
     def get_controller_info(self) -> Dict[str, Any]:
@@ -931,9 +966,12 @@ class ServeController:
             if info.autoscaler is not None:
                 # queue_len (ongoing + queued) — a replica with a full
                 # waiting room now registers as load even when its
-                # execution slots cap num_ongoing
+                # execution slots cap num_ongoing. LLM replicas also
+                # report engine telemetry (tokens/s, KV occupancy)
+                # that the policy may scale on (docs/LLM_SERVING.md).
                 decision = info.autoscaler.get_decision(
-                    len(handles), total_queue, now)
+                    len(handles), total_queue, now,
+                    signals=_aggregate_llm(per_replica))
                 if decision != info.target_replicas:
                     with self._lock:
                         info.target_replicas = decision

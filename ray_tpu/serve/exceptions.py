@@ -33,3 +33,24 @@ class ReplicaOverloadedError(Exception):
 class BatchSubmitTimeoutError(TimeoutError):
     """A @serve.batch submit waited longer than ``submit_timeout_s`` for
     the batch fn to produce a result (wedged or very slow batch fn)."""
+
+
+class StreamBrokenError(Exception):
+    """A token stream's replica died (or its stream state was lost)
+    mid-generation. The partial output is attached so the caller can
+    decide to retry the whole request or surface a CLEAN failure —
+    never a silent truncation (docs/LLM_SERVING.md).
+    """
+
+    def __init__(self, deployment_name: str = "",
+                 tokens_so_far: int = 0, cause: str = ""):
+        self.deployment_name = deployment_name
+        self.tokens_so_far = tokens_so_far
+        self.cause = cause
+        super().__init__(
+            f"token stream of deployment {deployment_name!r} broke "
+            f"after {tokens_so_far} tokens: {cause or 'replica died'}")
+
+    def __reduce__(self):
+        return (StreamBrokenError,
+                (self.deployment_name, self.tokens_so_far, self.cause))

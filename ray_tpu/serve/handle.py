@@ -68,6 +68,20 @@ class DeploymentHandle:
             release()
         return ref
 
+    def stream(self, payload=None, *, request_id=None,
+               assign_timeout: float = 30.0):
+        """Token streaming against an LLM deployment (serve/llm):
+        returns a ``ReplicaStream`` — iterate it for incremental chunk
+        dicts (``{"tokens", "text", "cursor", "done", ...}``); the
+        first chunk arrives as soon as the first token is decoded, not
+        when generation completes. Raises ``StreamBrokenError`` if the
+        replica dies mid-stream (retry the whole request; partial
+        output is never silently passed off as complete)."""
+        router = _get_router(self._controller)
+        return router.open_stream(self.deployment_name, payload,
+                                  request_id=request_id,
+                                  assign_timeout=assign_timeout)
+
     def __repr__(self):
         # stable across processes: the deployment version hash reprs
         # init args, and a memory-address repr would force a full

@@ -149,6 +149,17 @@ class HTTPProxyActor:
                                "method": self.command,
                                "request_id": self._request_id})
                     self._proxy_span = proxy_span  # closed in _respond
+                # token streaming (serve/llm): a JSON body with
+                # "stream": true — or an Accept: text/event-stream
+                # header — switches this request to SSE; tokens are
+                # written the moment the engine decodes them
+                wants_sse = ("text/event-stream"
+                             in (self.headers.get("Accept") or ""))
+                if (isinstance(payload, dict) and payload.get("stream")) \
+                        or (wants_sse and isinstance(payload,
+                                                     (dict, type(None)))):
+                    self._handle_stream(name, payload or {})
+                    return
                 for attempt in range(attempts):
                     try:
                         kwargs = {}
@@ -233,6 +244,90 @@ class HTTPProxyActor:
                     # likely still settling)
                     self._respond(503, {"error": repr(last_err),
                                         "retryable": True})
+
+            def _handle_stream(self, name: str, payload: dict):
+                """SSE token streaming: open a stream through the
+                shared router (same admission/overload behavior as
+                unary), then write one ``data:`` event per token chunk
+                as it lands — the client reads the first token while
+                the tail is still decoding. A mid-stream replica death
+                surfaces as an explicit error event, never a silently
+                truncated 200."""
+                import json as _json
+
+                from ray_tpu.serve.exceptions import StreamBrokenError
+                try:
+                    assign_timeout = float(os.environ.get(
+                        "RTPU_SERVE_PROXY_ASSIGN_TIMEOUT_S", 5.0))
+                except ValueError:
+                    assign_timeout = 5.0
+                sp = getattr(self, "_proxy_span", None)
+                try:
+                    stream = proxy._router.open_stream(
+                        name, payload, request_id=self._request_id,
+                        assign_timeout=assign_timeout,
+                        trace_parent=(sp.child_ctx() if sp else None))
+                except TimeoutError as e:
+                    self._respond(503, {"error": f"deployment {name!r} "
+                                                 f"saturated: {e}",
+                                        "retryable": True})
+                    return
+                except Exception as e:
+                    if is_overload_error(e):
+                        self._respond(503, {
+                            "error": str(e).split("\n")[0],
+                            "retryable": True})
+                    else:
+                        self._respond(500, {"error": repr(e)})
+                    return
+
+                def fold_usage(chunk):
+                    return {k: v for k, v in chunk.items()
+                            if k in ("tokens", "text", "cursor", "done",
+                                     "n_tokens", "finish_reason",
+                                     "ttft_s")}
+
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                # no Content-Length: the body ends when the connection
+                # closes (HTTP/1.1 §3.3.3) — stdlib-client friendly
+                self.send_header("Connection", "close")
+                if self._request_id:
+                    self.send_header("X-Request-Id", self._request_id)
+                if sp is not None:
+                    self.send_header("X-Trace-Id", sp.trace_id)
+                self.end_headers()
+                status = "ok"
+                try:
+                    for chunk in stream:
+                        self.wfile.write(
+                            b"data: "
+                            + _json.dumps(fold_usage(chunk)).encode()
+                            + b"\n\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except StreamBrokenError as e:
+                    status = "error"
+                    try:
+                        self.wfile.write(
+                            b"data: " + _json.dumps(
+                                {"error": str(e), "done": True,
+                                 "tokens_so_far": e.tokens_so_far}
+                            ).encode() + b"\n\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                except OSError:
+                    # client went away: abandon generation server-side
+                    status = "error"
+                    stream.cancel()
+                finally:
+                    self.close_connection = True
+                    if sp is not None:
+                        self._proxy_span = None
+                        sp.finish(status)
 
             def _respond(self, code: int, result: Any):
                 sp = getattr(self, "_proxy_span", None)

@@ -1,0 +1,36 @@
+"""ray_tpu.serve.llm — LLM inference on the serve data plane.
+
+Continuous batching + paged KV cache + end-to-end token streaming
+(docs/LLM_SERVING.md). The pieces:
+
+  engine.LLMEngine         per-replica continuous-batching scheduler
+  kv_cache.PagedKVCache    block allocator (vLLM-style pages)
+  model_runner             ToyAdapter / FlaxModelAdapter (gpt2, llama)
+  deployment.LLMServer     the serve deployment callable
+
+Typical use::
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMServer
+
+    dep = serve.deployment(name="llm", num_replicas=2)(LLMServer)
+    handle = serve.run(dep.bind("gpt2"), route_prefix="/llm")
+    for chunk in handle.stream({"prompt": "hello", "max_new_tokens": 32}):
+        print(chunk["text"], end="", flush=True)
+
+HTTP: POST the same payload with ``"stream": true`` (or
+``Accept: text/event-stream``) for SSE token streaming.
+"""
+
+from ray_tpu.serve.llm.deployment import ByteTokenizer, LLMServer
+from ray_tpu.serve.llm.engine import (EngineConfig, LLMEngine,
+                                      SamplingParams)
+from ray_tpu.serve.llm.kv_cache import OutOfKVBlocksError, PagedKVCache
+from ray_tpu.serve.llm.model_runner import (FlaxModelAdapter, ToyAdapter,
+                                            make_adapter)
+
+__all__ = [
+    "LLMServer", "LLMEngine", "EngineConfig", "SamplingParams",
+    "PagedKVCache", "OutOfKVBlocksError", "ToyAdapter",
+    "FlaxModelAdapter", "make_adapter", "ByteTokenizer",
+]

@@ -1,0 +1,195 @@
+"""LLMServer — the serve deployment callable hosting one engine.
+
+The first genuinely *stateful* serve workload: a replica holds an
+``LLMEngine`` (continuous batching + paged KV cache) and exposes it
+through the standard replica request path, so routing, backpressure,
+shedding, ledgers, tracing, HA and drain all apply unchanged:
+
+  ``__call__(payload)``            unary generate (existing proxy path)
+  ``__llm_open__(payload)``        start a stream -> {"stream_id"}
+  ``__llm_next__(sid, cursor, w)`` cursor poll -> token delta
+  ``__llm_cancel__(sid)``          abandon a stream
+  ``__llm_metrics__()``            engine metrics + token ledger
+
+Serve integration hooks (consumed by ``_private/replica.py``):
+
+  ``__serve_load__``         merged into ``get_load`` — in-flight
+                             sequences count as queue depth (the
+                             controller's drain poll waits for them:
+                             KV-aware graceful drain) and the ``llm``
+                             metrics ride the controller's telemetry
+                             into the autoscaler + Prometheus
+  ``__serve_prepare_drain__`` engine stops admitting, finishes decodes
+  ``__serve_drain_exempt__``  stream polls stay answerable while
+                             draining — an in-flight stream must be
+                             able to read its remaining tokens
+  ``__serve_prepare_shutdown__`` flush the per-request token ledger to
+                             the GCS KV so a replica retired by a
+                             rolling update keeps its half of the
+                             game-day per-token reconciliation
+
+Payload schema (dict): ``prompt`` (str, byte-tokenized) or ``tokens``
+(list[int]); optional ``max_new_tokens``, ``temperature``, ``seed``,
+``stop_token``, ``stream`` (proxy SSE opt-in), ``echo_text``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu.serve.llm.engine import (EngineConfig, LLMEngine,
+                                      SamplingParams)
+from ray_tpu.serve.llm.model_runner import make_adapter
+
+
+class ByteTokenizer:
+    """Dependency-free fallback: UTF-8 bytes, mod vocab. Real models
+    bring their own tokenizer; the toy/test path just needs a stable
+    string <-> tokens round trip."""
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return [b % self.vocab_size for b in text.encode("utf-8")]
+
+    def decode(self, tokens: List[int]) -> str:
+        return bytes(t % 256 for t in tokens).decode("utf-8", "replace")
+
+
+class LLMServer:
+    """Deployment callable: one engine per replica."""
+
+    # replica keeps answering these while draining — in-flight streams
+    # must drain their remaining tokens before the controller's kill
+    __serve_drain_exempt__ = ("__llm_next__", "__llm_cancel__",
+                              "__llm_metrics__")
+    # the replica normally strips the reserved request-id kwarg; the
+    # engine needs it for the per-request token ledger + trace spans
+    __serve_wants_request_id__ = True
+
+    def __init__(self, model: str = "toy",
+                 model_config: Optional[Dict[str, Any]] = None,
+                 engine_config: Optional[Dict[str, Any]] = None):
+        self.adapter = make_adapter(model, model_config)
+        cfg = EngineConfig(**(engine_config or {}))
+        self.engine = LLMEngine(self.adapter, cfg)
+        self.tokenizer = ByteTokenizer(self.adapter.vocab_size)
+        self.model = model
+
+    # ------------------------------------------------------------ intake
+
+    def _tokens_of(self, payload: Union[Dict[str, Any], str, list]
+                   ) -> List[int]:
+        if isinstance(payload, str):
+            return self.tokenizer.encode(payload)
+        if isinstance(payload, list):
+            return [int(t) for t in payload]
+        if isinstance(payload, dict):
+            if payload.get("tokens") is not None:
+                return [int(t) for t in payload["tokens"]]
+            if payload.get("prompt") is not None:
+                return self.tokenizer.encode(str(payload["prompt"]))
+        raise ValueError(
+            "LLM payload needs 'prompt' (str) or 'tokens' (list[int])")
+
+    def _open(self, payload, request_id: Optional[str]) -> str:
+        sampling = (SamplingParams.from_payload(payload)
+                    if isinstance(payload, dict) else SamplingParams())
+        # parent the engine's phase spans under THIS request's replica
+        # execute span (installed by replica._execute for sampled
+        # requests) so TTFT decomposes on the trace waterfall
+        trace_ctx = None
+        try:
+            from ray_tpu._private import worker as worker_mod
+            w = worker_mod._global_worker
+            if w is not None:
+                trace_ctx = getattr(w.task_context, "trace", None)
+        except Exception:
+            pass
+        return self.engine.add_request(
+            self._tokens_of(payload), sampling, request_id=request_id,
+            trace_ctx=dict(trace_ctx) if trace_ctx else None)
+
+    # --------------------------------------------------------- serve API
+
+    def __call__(self, payload=None, __rtpu_request_id__=None):
+        """Unary generation (the stateless-looking path: proxy POST
+        without ``stream``, plain ``handle.remote``)."""
+        rid = __rtpu_request_id__
+        sid = self._open(payload or {}, rid)
+        cursor = 0
+        tokens: List[int] = []
+        ttft = None
+        while True:
+            chunk = self.engine.poll(sid, cursor, max_wait_s=30.0)
+            tokens.extend(chunk["tokens"])
+            cursor = chunk["cursor"]
+            if chunk.get("ttft_s") is not None:
+                ttft = chunk["ttft_s"]
+            if chunk["done"]:
+                if chunk.get("error"):
+                    raise RuntimeError(
+                        f"generation failed: {chunk['error']}")
+                out = {"tokens": tokens, "n_tokens": len(tokens),
+                       "finish_reason": chunk.get("finish_reason"),
+                       "text": self.tokenizer.decode(tokens)}
+                if ttft is not None:
+                    out["ttft_s"] = ttft
+                return out
+
+    def __llm_open__(self, payload=None, __rtpu_request_id__=None):
+        sid = self._open(payload or {}, __rtpu_request_id__)
+        return {"stream_id": sid}
+
+    def __llm_next__(self, stream_id: str, cursor: int = 0,
+                     max_wait_s: float = 10.0):
+        chunk = self.engine.poll(stream_id, int(cursor),
+                                 max_wait_s=float(max_wait_s))
+        if chunk["tokens"]:
+            chunk["text"] = self.tokenizer.decode(chunk["tokens"])
+        return chunk
+
+    def __llm_cancel__(self, stream_id: str):
+        return {"cancelled": self.engine.cancel(stream_id)}
+
+    def __llm_metrics__(self):
+        m = self.engine.metrics()
+        m["token_ledger"] = self.engine.token_ledger()
+        return m
+
+    # ------------------------------------------------- serve integration
+
+    def __serve_load__(self) -> Dict[str, Any]:
+        m = self.engine.metrics()
+        return {
+            # in-flight sequences ARE queue depth: the router's p2c
+            # scoring sees decode load, the autoscaler sees pressure,
+            # and the controller's drain poll waits for zero
+            "queue_len_extra": m["running"] + m["waiting"],
+            "llm": m,
+        }
+
+    def __serve_prepare_drain__(self):
+        self.engine.prepare_drain()
+
+    def __serve_prepare_shutdown__(self, replica_name: str = ""):
+        """Best-effort token-ledger flush (rolling update / downscale):
+        reconciliation joins client token counts against it even after
+        this replica is gone."""
+        try:
+            from ray_tpu.gameday import store
+            ledger = self.engine.token_ledger()
+            if ledger:
+                store.flush_llm_ledger(replica_name, ledger)
+        except Exception:
+            pass
+        try:
+            self.engine.stop()
+        except Exception:
+            pass
+
+    def check_health(self):
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("LLM engine thread died")
+        return "ok"

@@ -1,0 +1,111 @@
+"""Paged (block) KV cache: the allocator behind continuous batching.
+
+Reference analogue: vLLM's BlockSpaceManager (PAPERS.md "Fine-Tuning
+and Serving Gemma 4 31B on Google Cloud TPU" serves through the same
+design). The cache is a fixed pool of fixed-size pages; each sequence
+owns a *block table* mapping its logical token positions to physical
+pages. Growing a sequence by one token allocates at most one page;
+finishing a sequence returns all its pages to the free list instantly.
+Admission control is therefore exact: a prompt of L tokens with a
+budget of G generated tokens needs ``ceil((L + G) / block_size)``
+pages, and the engine refuses to admit what it cannot finish —
+sequences never deadlock mid-decode waiting for pages.
+
+Page 0 is reserved as the *null page*: batch-padding rows point every
+block-table entry at it, so padded jit steps scatter their garbage
+into scratch instead of a live sequence's memory.
+
+The pool itself is storage-agnostic (``make_pages`` builds numpy or
+jax arrays per layer on demand) — the allocator tracks only indices,
+so the same bookkeeping serves the numpy toy adapter and the jitted
+flax adapters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class OutOfKVBlocksError(Exception):
+    """The pool cannot satisfy an allocation — the engine keeps the
+    sequence WAITING (or sheds it) rather than admitting work it
+    cannot finish."""
+
+
+class PagedKVCache:
+    """Block allocator + occupancy accounting for one replica's pool.
+
+    Thread-safe: the engine thread allocates/frees while actor threads
+    read occupancy for admission and telemetry.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (page 0 is reserved)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # page 0 reserved as the null/scratch page for padding rows
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._tables: Dict[str, List[int]] = {}   # seq id -> pages
+        self._lock = threading.Lock()
+
+    # ---- sizing ----
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return max(1, -(-int(num_tokens) // self.block_size))
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        with self._lock:
+            return len(self._free) >= self.blocks_for(num_tokens)
+
+    # ---- allocation ----
+
+    def allocate(self, seq_id: str, num_tokens: int) -> List[int]:
+        """Reserve every page a sequence will ever need (prompt +
+        generation budget) up front — exact admission, no mid-decode
+        OOM."""
+        need = self.blocks_for(num_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            if len(self._free) < need:
+                raise OutOfKVBlocksError(
+                    f"need {need} KV blocks, {len(self._free)} free "
+                    f"(pool {self.num_blocks - 1})")
+            pages = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = pages
+            return list(pages)
+
+    def free(self, seq_id: str) -> int:
+        """Return a finished sequence's pages; freed capacity is
+        admittable on the very next engine step."""
+        with self._lock:
+            pages = self._tables.pop(seq_id, None)
+            if not pages:
+                return 0
+            self._free.extend(reversed(pages))
+            return len(pages)
+
+    def block_table(self, seq_id: str) -> Optional[List[int]]:
+        with self._lock:
+            t = self._tables.get(seq_id)
+            return list(t) if t else None
+
+    # ---- telemetry (autoscaler signal: docs/LLM_SERVING.md) ----
+
+    def occupancy(self) -> float:
+        """Fraction of the usable pool currently owned by sequences."""
+        with self._lock:
+            usable = self.num_blocks - 1
+            return (usable - len(self._free)) / max(1, usable)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            usable = self.num_blocks - 1
+            used = usable - len(self._free)
+            return {"kv_blocks_total": usable,
+                    "kv_blocks_used": used,
+                    "kv_block_size": self.block_size,
+                    "kv_occupancy": used / max(1, usable),
+                    "kv_sequences": len(self._tables)}

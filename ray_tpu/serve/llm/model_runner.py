@@ -1,0 +1,274 @@
+"""Model adapters: the engine's prefill/decode contract.
+
+An adapter owns the *storage* of the paged KV pool (the engine's
+``PagedKVCache`` owns only the allocator) and exposes exactly two
+compute entry points:
+
+    prefill(seqs) -> logits [B, V]   write the prompts' KV into their
+                                     pages, return last-token logits
+    decode(seqs)  -> logits [B, V]   append each sequence's newest
+                                     sampled token, attend against the
+                                     cached prefix, return next logits
+
+Two implementations:
+
+* ``ToyAdapter`` — a dependency-free numpy language model whose next
+  token is a deterministic function of the cached prefix READ BACK
+  THROUGH THE BLOCK TABLES (a paging bug corrupts its output, which is
+  exactly what the continuous-vs-static equivalence gate wants).
+  Configurable per-step latency makes it the load-bearing workload for
+  the game day and ``_BENCH_LLM`` without flax in the loop.
+
+* ``FlaxModelAdapter`` — wraps ``models/gpt2.py`` / ``models/llama.py``
+  incremental-decode paths: bucketed (batch, length) jit shapes, paged
+  caches threaded through ``ops.attention.cached_attention``, padding
+  rows parked on the null page. On TPU the single-token decode rides
+  the ``paged_attention_decode`` Pallas kernel via the shared cached
+  paths; on CPU the gather reference keeps numerics identical.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _pad_pow2(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+class ToyAdapter:
+    """Deterministic numpy LM over the paged pool (tests, game day,
+    bench). Each token's "KV" is its embedding; the next-token logits
+    are ``mean(cached embeddings) @ E^T`` — prefix-dependent, exactly
+    reproducible, and read through the block tables so paging bugs are
+    visible as wrong tokens, not just wrong latency."""
+
+    def __init__(self, vocab_size: int = 256, dim: int = 32,
+                 seed: int = 0, step_delay_s: float = 0.0,
+                 per_seq_delay_s: float = 0.0,
+                 per_prefill_token_delay_s: float = 0.0):
+        rng = np.random.RandomState(seed)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.embed = rng.randn(self.vocab_size, self.dim).astype(
+            np.float32)
+        self.step_delay_s = float(step_delay_s)
+        self.per_seq_delay_s = float(per_seq_delay_s)
+        self.per_prefill_token_delay_s = float(per_prefill_token_delay_s)
+        self._lock = threading.Lock()
+
+    def bind_cache(self, cache):
+        self.cache = cache
+        self.pages = np.zeros(
+            (cache.num_blocks, cache.block_size, self.dim), np.float32)
+        # seq id -> {"table": np.ndarray pages, "len": cached tokens}
+        self._state: Dict[str, Dict[str, Any]] = {}
+
+    def _write(self, st, tokens: List[int]):
+        bs = self.cache.block_size
+        table = st["table"]
+        for i, tok in enumerate(tokens):
+            pos = st["len"] + i
+            self.pages[table[pos // bs], pos % bs] = self.embed[tok]
+        st["len"] += len(tokens)
+
+    def _logits(self, st) -> np.ndarray:
+        bs = self.cache.block_size
+        table = st["table"]
+        n = st["len"]
+        nb = -(-n // bs)
+        flat = self.pages[table[:nb]].reshape(nb * bs, self.dim)[:n]
+        h = flat.mean(axis=0)
+        return (h @ self.embed.T).astype(np.float32)
+
+    def prefill(self, seqs) -> np.ndarray:
+        n_tok = sum(len(s.prompt) for s in seqs)
+        if self.step_delay_s or self.per_prefill_token_delay_s:
+            time.sleep(self.step_delay_s
+                       + self.per_prefill_token_delay_s * n_tok)
+        out = np.zeros((len(seqs), self.vocab_size), np.float32)
+        with self._lock:
+            for i, s in enumerate(seqs):
+                st = {"table": np.asarray(
+                    self.cache.block_table(s.seq_id), np.int64),
+                    "len": 0}
+                self._state[s.seq_id] = st
+                self._write(st, s.prompt)
+                out[i] = self._logits(st)
+        return out
+
+    def decode(self, seqs) -> np.ndarray:
+        if self.step_delay_s or self.per_seq_delay_s:
+            time.sleep(self.step_delay_s
+                       + self.per_seq_delay_s * len(seqs))
+        out = np.zeros((len(seqs), self.vocab_size), np.float32)
+        with self._lock:
+            for i, s in enumerate(seqs):
+                st = self._state[s.seq_id]
+                self._write(st, [s.tokens[-1]])
+                out[i] = self._logits(st)
+        return out
+
+    def release(self, seq_id: str):
+        with self._lock:
+            self._state.pop(seq_id, None)
+
+
+class FlaxModelAdapter:
+    """GPT-2 / Llama incremental decode over the paged pool.
+
+    jit shapes are bucketed (batch to a power of two, prompt length to
+    a power of two >= 8); padding rows carry zero lengths and
+    null-page block tables, so they scatter into scratch and attend to
+    nothing. Pages live as stacked per-layer jax arrays
+    ([L, P, bs, Hkv, D]) and are donated through every step — the pool
+    is updated in place, never copied.
+    """
+
+    def __init__(self, kind: str = "gpt2", config=None,
+                 params=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.kind = kind
+        if kind == "gpt2":
+            from ray_tpu.models import gpt2
+            self.cfg = config or gpt2.GPT2Config.tiny()
+            self.model = gpt2.GPT2(self.cfg)
+            self.n_kv_heads = self.cfg.n_head
+            self.head_dim = self.cfg.n_embd // self.cfg.n_head
+            self.vocab_size = self.cfg.vocab_size
+        elif kind == "llama":
+            from ray_tpu.models import llama
+            self.cfg = config or llama.LlamaConfig.tiny()
+            self.model = llama.LlamaModel(self.cfg)
+            self.n_kv_heads = self.cfg.n_kv_heads
+            self.head_dim = self.cfg.head_dim
+            self.vocab_size = self.cfg.vocab_size
+        else:
+            raise ValueError(f"unknown model kind {kind!r}")
+        if params is None:
+            dummy = jnp.zeros((1, 8), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        self.params = params
+        self._fns: Dict[Any, Any] = {}     # (B, S, NB) -> jitted step
+        self._lock = threading.Lock()
+
+    @property
+    def n_layers(self) -> int:
+        return getattr(self.cfg, "n_layer",
+                       getattr(self.cfg, "n_layers", 0))
+
+    def bind_cache(self, cache):
+        jnp = self._jnp
+        self.cache = cache
+        dtype = self.cfg.dtype
+        shape = (self.n_layers, cache.num_blocks, cache.block_size,
+                 self.n_kv_heads, self.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # NB: every block table is padded to the worst-case blocks per
+        # sequence so decode jits once per batch bucket
+        self.nb_max = cache.blocks_for(
+            getattr(self.cfg, "n_positions",
+                    getattr(self.cfg, "max_seq_len", 2048)))
+        self._state: Dict[str, Dict[str, Any]] = {}
+
+    def _step_fn(self, B: int, S: int):
+        key = (B, S)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        jnp = self._jnp
+        L = self.n_layers
+
+        def step(params, tokens, k_pages, v_pages, block_tables,
+                 seq_lengths, valid):
+            caches = [{"k_pages": k_pages[l], "v_pages": v_pages[l],
+                       "block_tables": block_tables}
+                      for l in range(L)]
+            logits, new = self.model.apply(
+                params, tokens, kv_cache=caches,
+                seq_lengths=seq_lengths, valid=valid)
+            k_new = jnp.stack([c["k_pages"] for c in new])
+            v_new = jnp.stack([c["v_pages"] for c in new])
+            # last REAL token's logits per row
+            idx = jnp.maximum(
+                jnp.sum(valid.astype(jnp.int32), axis=1) - 1, 0)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+            return last, k_new, v_new
+
+        # donate the pools on TPU (in-place page update, zero copy);
+        # CPU ignores donation and would warn on every compile
+        donate = (2, 3) if jax.devices()[0].platform == "tpu" else ()
+        fn = jax.jit(step, donate_argnums=donate)
+        self._fns[key] = fn
+        return fn
+
+    def _run(self, rows: List[Dict[str, Any]]) -> np.ndarray:
+        """rows: [{tokens: [ints], len: cache length, table: [pages]}]
+        -> last-token logits for the real rows."""
+        jnp = self._jnp
+        B = _pad_pow2(len(rows))
+        S = _pad_pow2(max(len(r["tokens"]) for r in rows), 8)
+        tokens = np.zeros((B, S), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        valid = np.zeros((B, S), bool)
+        tables = np.zeros((B, self.nb_max), np.int32)
+        for i, r in enumerate(rows):
+            n = len(r["tokens"])
+            tokens[i, :n] = r["tokens"]
+            lengths[i] = r["len"]
+            valid[i, :n] = True
+            t = r["table"][:self.nb_max]
+            tables[i, :len(t)] = t
+        fn = self._step_fn(B, S)
+        with self._lock:
+            logits, self.k_pages, self.v_pages = fn(
+                self.params, jnp.asarray(tokens), self.k_pages,
+                self.v_pages, jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(valid))
+        return np.asarray(logits[:len(rows)], np.float32)
+
+    def prefill(self, seqs) -> np.ndarray:
+        rows = []
+        for s in seqs:
+            table = self.cache.block_table(s.seq_id)
+            self._state[s.seq_id] = {"table": table,
+                                     "len": len(s.prompt)}
+            rows.append({"tokens": s.prompt, "len": 0, "table": table})
+        return self._run(rows)
+
+    def decode(self, seqs) -> np.ndarray:
+        rows = []
+        for s in seqs:
+            st = self._state[s.seq_id]
+            rows.append({"tokens": [s.tokens[-1]], "len": st["len"],
+                         "table": st["table"]})
+            st["len"] += 1
+        return self._run(rows)
+
+    def release(self, seq_id: str):
+        self._state.pop(seq_id, None)
+
+
+def make_adapter(model: str = "toy",
+                 model_config: Optional[Dict[str, Any]] = None):
+    """Deployment-facing factory: ``model`` is ``toy`` |
+    ``gpt2`` | ``llama`` (tiny test configs unless ``model_config``
+    overrides)."""
+    model_config = dict(model_config or {})
+    if model == "toy":
+        return ToyAdapter(**model_config)
+    if model in ("gpt2", "llama"):
+        return FlaxModelAdapter(kind=model, **model_config)
+    raise ValueError(f"unknown model {model!r} (toy | gpt2 | llama)")
