@@ -35,6 +35,16 @@ Spec filters: ``proc`` (role: driver/worker/raylet/gcs), ``head``
 Sites wired through the runtime:
 
     protocol.send / protocol.recv   drop | delay | dup | reset
+                                    (BOTH implementations of the wire:
+                                    the asyncio Connection loops in
+                                    protocol.py AND the native frame
+                                    pump's direct-execution lane in
+                                    direct.py hit these sites at the
+                                    frame boundary with identical
+                                    semantics, so one seeded schedule
+                                    replays against either —
+                                    docs/WIRE_PROTOCOL.md
+                                    "Implementations")
     rpc.request                     kill (server-side, any process)
     worker.execute                  kill (the executing worker, SIGKILL)
     raylet.dispatch                 kill_worker | kill | preempt

@@ -37,6 +37,10 @@ def main():
     reply = w.call_sync(w.raylet, "worker_register", {
         "worker_id": os.environ["RTPU_WORKER_ID"],
         "address": w.address,
+        # 1.7: the native direct-call lane's socket (empty when the
+        # pump is disabled/unbuildable); the raylet forwards it in
+        # lease_worker replies so owners can skip the asyncio path
+        "direct_address": w.direct_address,
     })
     from ray_tpu.common.config import SystemConfig, set_global_config
     w.config = SystemConfig.from_json(reply["config"])

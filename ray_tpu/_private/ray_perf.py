@@ -8,11 +8,20 @@ host. A TPU host runs O(1-8) model workers whose step time is 10-100 ms;
 the control plane only has to stay far off the critical path at that scale.
 
 Run: ``python -m ray_tpu._private.ray_perf [--json out.json]``
+
+``--breakdown`` reports per-roundtrip PHASE attribution for the unary
+sync path instead of one throughput number: each round trip is split
+into wire / dispatch / execute / reply using the task-event phase
+timestamps the state engine records (docs/OBSERVABILITY.md,
+docs/TRACING.md) plus client-side clocks.  This is how a change to the
+RPC plane (e.g. the native frame pump, RTPU_NATIVE_RPC) is graded as a
+histogram, not a single mean.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -169,8 +178,94 @@ def main(json_path: Optional[str] = None) -> Dict[str, float]:
     return summary
 
 
+def breakdown(n: int = 400, json_path: Optional[str] = None
+              ) -> Dict[str, Dict[str, float]]:
+    """Per-roundtrip phase attribution for unary sync tasks.
+
+    Phases (wall-clock, one host — client clocks and the task table's
+    per-state stamps share a clock):
+
+    - ``wire``:     client submit entry -> PENDING_SCHEDULING stamp
+                    (owner-side submit bookkeeping: serialize + spec)
+    - ``dispatch``: PENDING_SCHEDULING -> RUNNING (frame encode, the
+                    send/recv path, worker wakeup + decode)
+    - ``execute``:  RUNNING -> FINISHED (user fn + return shipping)
+    - ``reply``:    FINISHED -> client get() return (reply frame,
+                    owner-side result store, getter wakeup +
+                    deserialize)
+    """
+    import ray_tpu
+    from ray_tpu.experimental.state import api as state_api
+
+    ray_tpu.init(ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    def small_value():
+        return b"ok"
+
+    for _ in range(100):  # warm the lease/lane + function cache
+        ray_tpu.get(small_value.remote())
+
+    samples = []
+    for _ in range(n):
+        t0 = time.time()
+        ref = small_value.remote()
+        ray_tpu.get(ref)
+        t1 = time.time()
+        samples.append((ref.id().task_id().hex(), t0, t1))
+    time.sleep(1.5)  # let the task-event flush tick ship the stamps
+
+    recs = {r["task_id"]: r for r in state_api.list_tasks(limit=10 * n)}
+    phases: Dict[str, List[float]] = {
+        "wire": [], "dispatch": [], "execute": [], "reply": [],
+        "total": []}
+    missing = 0
+    for tid, t0, t1 in samples:
+        st = (recs.get(tid) or {}).get("state_ts") or {}
+        if not all(k in st for k in ("PENDING_SCHEDULING", "RUNNING",
+                                     "FINISHED")):
+            missing += 1
+            continue
+        phases["wire"].append(st["PENDING_SCHEDULING"] - t0)
+        phases["dispatch"].append(st["RUNNING"] - st["PENDING_SCHEDULING"])
+        phases["execute"].append(st["FINISHED"] - st["RUNNING"])
+        phases["reply"].append(t1 - st["FINISHED"])
+        phases["total"].append(t1 - t0)
+    ray_tpu.shutdown()
+
+    def _q(v: List[float], q: float) -> float:
+        s = sorted(v)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    out: Dict[str, Dict[str, float]] = {}
+    native = os.environ.get("RTPU_NATIVE_RPC", "1") not in ("0", "false")
+    print(f"unary sync phase breakdown ({len(phases['total'])} samples, "
+          f"{missing} missing stamps, native_rpc={'on' if native else 'off'})")
+    print(f"{'phase':10s} {'p50':>9s} {'p90':>9s} {'p99':>9s} {'mean':>9s}")
+    for k, v in phases.items():
+        if not v:
+            continue
+        row = {"p50_us": _q(v, 0.5) * 1e6, "p90_us": _q(v, 0.9) * 1e6,
+               "p99_us": _q(v, 0.99) * 1e6,
+               "mean_us": statistics.fmean(v) * 1e6}
+        out[k] = {kk: round(vv, 1) for kk, vv in row.items()}
+        print(f"{k:10s} {row['p50_us']:8.0f}u {row['p90_us']:8.0f}u "
+              f"{row['p99_us']:8.0f}u {row['mean_us']:8.0f}u")
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    return out
+
+
 if __name__ == "__main__":
     out = None
     if "--json" in sys.argv:
         out = sys.argv[sys.argv.index("--json") + 1]
-    main(out)
+    if "--breakdown" in sys.argv:
+        n = 400
+        if "--n" in sys.argv:
+            n = int(sys.argv[sys.argv.index("--n") + 1])
+        breakdown(n, out)
+    else:
+        main(out)

@@ -185,6 +185,7 @@ class WorkerHandle:
         self.tpu_chips = tpu_chips
         self.conn: Optional[protocol.Connection] = None
         self.address: str = ""
+        self.direct_address: str = ""  # native direct-call lane (1.7)
         self.busy_task: Optional[str] = None
         self.leased_by: Optional[str] = None
         self.is_actor = False
@@ -598,6 +599,7 @@ class Raylet:
             raise protocol.RpcError(f"unknown worker {wid}")
         handle.conn = conn
         handle.address = payload["address"]
+        handle.direct_address = payload.get("direct_address") or ""
         conn.meta["worker_id"] = wid
         if not handle.ready.done():
             handle.ready.set_result(True)
@@ -1169,7 +1171,11 @@ class Raylet:
         self._lease_owner_conns[lease_tag] = conn
         conn.meta.setdefault("leases", []).append(lease_tag)
         return {"lease_id": lease_tag, "worker_id": handle.worker_id,
-                "worker_address": handle.address}
+                "worker_address": handle.address,
+                # 1.7 (optional — pre-1.7 owners ignore it): lets the
+                # owner push leased tasks down the worker's native
+                # direct-execution lane instead of the asyncio server
+                "direct_address": handle.direct_address}
 
     async def handle_release_lease(self, payload, conn):
         self._release_lease(payload.get("lease_id", ""))

@@ -56,7 +56,15 @@ from typing import Any, Dict, List, Optional, Tuple
 #      every stage peer negotiated >= 1.6 via __hello__ — a legacy peer
 #      runs the graph untraced, never broken), trace_table_max on
 #      configure_state — docs/TRACING.md.
-PROTOCOL_VERSION = (1, 6)
+# 1.7: native direct-execution lane — optional direct_address on
+#      worker_register and lease_worker replies (the worker's second
+#      listening socket served by the native frame pump; leased tasks
+#      pushed there run recv→decode→execute→reply on one thread). All
+#      frames on the direct socket are standard 1.x frames; an owner or
+#      worker without the native library simply never sees/sends the
+#      field and everything rides the asyncio path —
+#      docs/WIRE_PROTOCOL.md "Implementations".
+PROTOCOL_VERSION = (1, 7)
 
 _str = str
 _num = numbers.Number
@@ -251,7 +259,10 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     # ---- worker lifecycle (the second-language worker surface —
     # docs/WIRE_PROTOCOL.md declares this table normative for it)
     "worker_register": {"worker_id": (_str, True),
-                        "address": (_str, True)},
+                        "address": (_str, True),
+                        # 1.7: native direct-call lane socket ("" when
+                        # the pump is disabled)
+                        "direct_address": (_str, False)},
     "push_task": {"spec": (_dict, True), "tpu_chips": (_list, False)},
     "task_result": {"task_id": (_str, True), "returns": (_list, True),
                     "app_error": (_bool, False)},

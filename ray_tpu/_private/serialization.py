@@ -50,6 +50,12 @@ class SerializedObject:
         self.contained_refs = contained_refs
 
     def to_bytes(self) -> bytes:
+        if not self.buffers:
+            # inline fast path (scalars, small replies): one concat
+            # instead of bytearray + memoryview + write_into
+            hdr = self.header
+            return (struct.pack("<I", len(hdr)) + hdr
+                    + b"\x00" * (self.total_size - 4 - len(hdr)))
         out = bytearray(self.total_size)
         self.write_into(memoryview(out))
         return bytes(out)

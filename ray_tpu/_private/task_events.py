@@ -50,8 +50,22 @@ STATE_RANK = {
 }
 
 
+_RING_CAP: Optional[int] = None
+
+
 def _ring_cap() -> int:
-    return int(os.environ.get("RTPU_TASK_EVENTS_BUFFER", 8192))
+    # cached: this sits on the per-task emit path and an environ read
+    # per event is measurable there (tests that change the env call
+    # _reset_ring_cap / set the module global directly)
+    global _RING_CAP
+    if _RING_CAP is None:
+        _RING_CAP = int(os.environ.get("RTPU_TASK_EVENTS_BUFFER", 8192))
+    return _RING_CAP
+
+
+def _reset_ring_cap():
+    global _RING_CAP
+    _RING_CAP = None
 
 
 def _flush_interval() -> float:

@@ -37,6 +37,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import chaos, protocol, serialization
 from ray_tpu._private import task_events as tev
+
+# ray_tpu.util imports back into this module, so the timeline module is
+# bound lazily on first task execution (cached here — a per-task
+# ``from ray_tpu.util import timeline`` showed up in lane profiles)
+_timeline = None
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.object_store import MemoryStore, PlasmaxStore
 from ray_tpu.common.config import SystemConfig, global_config, set_global_config
@@ -47,6 +52,11 @@ logger = logging.getLogger(__name__)
 
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
+
+# _execute_task reply sentinel: the direct lane (direct.py) executes on
+# the receiving thread and wants the result dict RETURNED, not delivered
+# through an asyncio future
+DIRECT_REPLY = "direct"
 
 
 # --------------------------------------------------------------------------
@@ -353,7 +363,7 @@ class _CallbackEvent(threading.Event):
 
 class PendingTaskState:
     __slots__ = ("spec", "retries_left", "return_ids", "done",
-                 "result_event", "worker_address", "attempt")
+                 "result_event", "worker_address", "attempt", "direct")
 
     def __init__(self, spec, retries_left, return_ids):
         self.spec = spec
@@ -363,6 +373,7 @@ class PendingTaskState:
         self.result_event = _CallbackEvent()
         self.worker_address = None
         self.attempt = 0  # bumped per retry; rides spec["attempt"]
+        self.direct = False  # in flight on the native direct lane
 
 
 class _LeaseState:
@@ -412,6 +423,8 @@ class Worker:
         self._put_counter = 0
         self._put_lock = threading.Lock()
         self.pending_tasks: Dict[str, PendingTaskState] = {}
+        # fn_key -> (opts snapshot, shared spec fields); see submit_task
+        self._shared_spec_cache: Dict[str, Tuple] = {}
         self._submit_buf: List[Tuple[Dict[str, Any], PendingTaskState]] = []
         self._submit_lock = threading.Lock()
         self._submit_flush_scheduled = False
@@ -455,6 +468,12 @@ class Worker:
         # waiter refcount]
         self._obj_channels: Dict[str, list] = {}
         self._obj_channel_lock = threading.Lock()
+        # native direct-execution lane (direct.py; RTPU_NATIVE_RPC):
+        # workers run a DirectServer beside the asyncio server, drivers
+        # route qualifying leased tasks through a DirectClient
+        self.direct_address = ""
+        self._direct_server = None
+        self._direct_client = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -472,6 +491,27 @@ class Worker:
         self._server = protocol.Server(self._handlers())
         self.io.run(self._server.start_unix(sock))
         self.address = f"unix:{sock}"
+        if mode == MODE_WORKER:
+            # direct-execution lane (perf; docs/WIRE_PROTOCOL.md
+            # "Implementations"): a second listening socket served by the
+            # native frame pump, where leased unary tasks run
+            # recv→decode→execute→reply on one thread. Any failure here
+            # (library didn't build, RTPU_NATIVE_RPC=0) just leaves the
+            # asyncio path in charge.
+            from ray_tpu._private import rpccore
+            if rpccore.available():
+                try:
+                    from ray_tpu._private import direct
+                    dsock = os.path.join(
+                        session_dir,
+                        f"cw_{self.worker_id.hex()[:12]}.direct.sock")
+                    self._direct_server = direct.DirectServer(self, dsock)
+                    self.direct_address = self._direct_server.address
+                except Exception:
+                    logger.warning("direct lane unavailable; using the "
+                                   "asyncio path", exc_info=True)
+                    self._direct_server = None
+                    self.direct_address = ""
         self.gcs_address = gcs_address
         # survives a GCS restart: calls retry after re-dial (GCS fault
         # tolerance; reference: gcs_rpc_client.h reconnection). The
@@ -510,6 +550,17 @@ class Worker:
             except Exception:
                 pass
         if mode == MODE_DRIVER:
+            if self.raylet is not None:
+                from ray_tpu._private import rpccore
+                if rpccore.available():
+                    try:
+                        from ray_tpu._private import direct
+                        self._direct_client = direct.DirectClient(self)
+                    except Exception:
+                        logger.warning(
+                            "direct client unavailable; using the "
+                            "asyncio lease pool", exc_info=True)
+                        self._direct_client = None
             chaos.init_from_env("driver")
             r = self.io.run(self.gcs.call("next_job_id", {}))
             self.job_id = JobID.from_int(r["job_index"])
@@ -571,6 +622,22 @@ class Worker:
         except Exception:
             pass
         self.connected = False
+        # native direct lane: stop the lane/delivery threads and free
+        # the pumps before the io loop (their fallback resubmits and
+        # lease releases ride it)
+        dc, self._direct_client = self._direct_client, None
+        if dc is not None:
+            try:
+                dc.close()
+            except Exception:
+                pass
+        ds, self._direct_server = self._direct_server, None
+        if ds is not None:
+            try:
+                ds.close()
+            except Exception:
+                pass
+        self.direct_address = ""
         # compiled-DAG channels: close the listener + stage sockets and
         # free the plasmax ring slots before the store goes away
         ep = getattr(self, "_dag_endpoint", None)
@@ -893,11 +960,21 @@ class Worker:
                         raise exc.GetTimeoutError(
                             f"get() timed out during recovery of {oid}")
                     continue
-            # 2. local plasma
+            # 2. a task WE submitted that is still in flight: wait for
+            # completion before probing plasma — the sync-get hot path
+            # was paying two ctypes store probes per wait loop for an
+            # object that cannot be sealed yet
+            state = self.pending_tasks.get(oid.task_id().hex())
+            if state is not None and not state.done:
+                if not self._resolve_remote(ref, deadline):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {oid}")
+                continue
+            # 3. local plasma
             buf = self.plasma.get_buffer(oid)
             if buf is not None:
                 return self._deserialize_plasma(oid, buf)
-            # 3. ask the owner / locate
+            # 4. ask the owner / locate
             if not self._resolve_remote(ref, deadline):
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for {oid}")
@@ -980,7 +1057,14 @@ class Worker:
         # we are the owner (or owner unknown): wait on local delivery
         state = self.pending_tasks.get(oid.task_id().hex())
         if state is not None and not state.done:
-            state.result_event.wait(step)
+            dc = self._direct_client
+            if dc is not None and state.direct and not dc._closed:
+                # direct-lane task: reap the reply on THIS thread (the
+                # getter pumps the native reactor; no delivery-thread
+                # handoff on the sync path)
+                dc.reap_result(state, step)
+            else:
+                state.result_event.wait(step)
             return timeout is None or self._remaining(deadline) > 0
         if self.memory_store.contains(oid) or self.plasma.contains(oid):
             return True
@@ -1140,28 +1224,50 @@ class Worker:
         num_returns = opts.get("num_returns")
         if num_returns is None:
             num_returns = 1
-        return {
+        spec = {
             "fn_key": fn_key,
             "fn_name": fn_name,
             "num_returns": num_returns,
             "owner_address": self.address,
             "job_id": self.job_id.hex(),
             "resources": resource_dict_from_options(opts, is_actor=False),
-            "runtime_env": self.prepare_runtime_env(opts.get("runtime_env")),
-            "scheduling": self._scheduling_from_opts(opts),
-            "placement_group": self._pg_from_opts(opts),
             "max_retries": opts.get("max_retries",
                                     self.config.task_max_retries_default),
-            "retry_exceptions": bool(opts.get("retry_exceptions")),
         }
+        # optional fields ride the wire only when set (every consumer
+        # reads them with .get): at thousands of tasks/s the empty
+        # runtime_env/scheduling/placement_group/retry_exceptions keys
+        # were measurable pack+unpack weight on each leased frame
+        runtime_env = self.prepare_runtime_env(opts.get("runtime_env"))
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
+        scheduling = self._scheduling_from_opts(opts)
+        if scheduling:
+            spec["scheduling"] = scheduling
+        pg = self._pg_from_opts(opts)
+        if pg is not None:
+            spec["placement_group"] = pg
+        if opts.get("retry_exceptions"):
+            spec["retry_exceptions"] = True
+        return spec
 
     def submit_task(self, fn_key: str, fn_name: str, args, kwargs,
                     opts: Dict[str, Any]) -> List[ObjectRef]:
         task_id = TaskID.for_task(self.current_task_id
                                   or TaskID.for_driver(self.job_id))
         arg_blob, plasma_deps, arg_refs = self._serialize_args(args, kwargs)
-        spec = dict(self._shared_spec_fields(fn_key, fn_name, opts),
-                    task_id=task_id.hex(), args=arg_blob,
+        # shared fields are identical for every call of one function
+        # under one options dict — cache them (hot unary path; a
+        # runtime_env opts set is excluded: its content fingerprint of
+        # local dirs must be recomputed per submit)
+        cached = self._shared_spec_cache.get(fn_key)
+        if cached is not None and cached[0] == opts:
+            shared = cached[1]
+        else:
+            shared = self._shared_spec_fields(fn_key, fn_name, opts)
+            if not opts.get("runtime_env"):
+                self._shared_spec_cache[fn_key] = (dict(opts), shared)
+        spec = dict(shared, task_id=task_id.hex(), args=arg_blob,
                     plasma_deps=plasma_deps, arg_refs=arg_refs)
         return self.submit_spec(spec)
 
@@ -1223,7 +1329,7 @@ class Worker:
     def _trace_ctx_for_submit(self) -> Dict[str, str]:
         cur = self._current_trace()
         return {"trace_id": cur["trace_id"],
-                "span_id": os.urandom(8).hex(),
+                "span_id": os.urandom(8).hex(),  # one urandom per submit
                 "parent_span_id": cur["span_id"]}
 
     def submit_spec(self, spec, reconstruction: bool = False) -> List[ObjectRef]:
@@ -1284,6 +1390,15 @@ class Worker:
         silently orphaned parked tasks (round-5 review finding)."""
         if not self._lease_qualifies(spec):
             return False
+        dc = self._direct_client
+        if dc is not None and dc.usable():
+            # the native lane owns leasing for this process: when it
+            # declines (lease denied recently, parked queue overflow)
+            # the task goes to the BATCHED raylet path — never to the
+            # asyncio lease pool, which would build a second pool
+            # competing for the same node capacity and thrash the
+            # raylet's lease-revoke logic
+            return dc.submit(spec, state)
         key = tuple(sorted((spec.get("resources") or {}).items()))
         pool = self._worker_leases.get(key)
         if not pool and time.monotonic() - self._lease_fail_at.get(
@@ -1301,21 +1416,30 @@ class Worker:
             return
         self.io.call_soon(self._cancel_leased_io, task_id, state)
 
+    def _resolve_cancelled(self, task_id, state):
+        """Resolve a never-dispatched task as cancelled (refs get the
+        TaskCancelledError envelope, the state table goes terminal)."""
+        err = exc.TaskCancelledError(task_id)
+        ser = serialization.serialize_error(err)
+        for oid in state.return_ids:
+            self.memory_store.put(oid, ser.to_bytes())
+        tev.emit(task_id, tev.FAILED,
+                 name=state.spec.get("fn_name"),
+                 job_id=state.spec.get("job_id"),
+                 error="CANCELLED: never dispatched")
+        state.done = True
+        state.result_event.set()
+        self.pending_tasks.pop(task_id, None)
+
     def _cancel_leased_io(self, task_id, state):
+        dc = self._direct_client
+        if dc is not None and dc.cancel(task_id, state):
+            return
         for key, waiters in list(self._lease_waiters.items()):
             for item in waiters:
                 if item[0]["task_id"] == task_id:
                     waiters.remove(item)
-                    err = exc.TaskCancelledError(task_id)
-                    ser = serialization.serialize_error(err)
-                    for oid in state.return_ids:
-                        self.memory_store.put(oid, ser.to_bytes())
-                    tev.emit(task_id, tev.FAILED,
-                             name=state.spec.get("fn_name"),
-                             job_id=state.spec.get("job_id"),
-                             error="CANCELLED: never dispatched")
-                    state.done = True
-                    state.result_event.set()
+                    self._resolve_cancelled(task_id, state)
                     return
         if state.worker_address:
             async def _send():
@@ -1508,6 +1632,9 @@ class Worker:
         that ack, so it never hands the dispatch loop a worker that is
         still executing our leased tasks."""
         lease_id = payload.get("lease_id")
+        dc = self._direct_client
+        if dc is not None and dc.on_revoke(lease_id):
+            return {}
         for pool in self._worker_leases.values():
             for L in list(pool):
                 if L.lease_id == lease_id:
@@ -1610,11 +1737,30 @@ class Worker:
                  error=f"{err}: {reply.get('message', '')}"[:200])
         state.done = True
         state.result_event.set()
+        self.pending_tasks.pop(state.spec.get("task_id"), None)
+
+    _SCALAR_ARG_TYPES = (type(None), bool, int, float, str, bytes)
 
     def _serialize_args(self, args, kwargs):
         """Serialize task args. Large arg values are promoted to plasma
         objects (implicit put) so they ride the object plane; refs are listed
         as dependencies for the executing raylet to pre-fetch."""
+        if not kwargs and all(type(a) in self._SCALAR_ARG_TYPES
+                              for a in args):
+            # scalar fast path: an msgpack-inline envelope — no pickle,
+            # no ref collection (scalars can't contain ObjectRefs), no
+            # deps. serialization.deserialize takes its existing
+            # "inline" branch, so the executing worker skips
+            # pickle.loads too. Exact-type checks keep user containers
+            # (whose tuples must survive round-trip) on the pickle path.
+            try:
+                import struct as _struct
+                import msgpack as _msgpack
+                header = _msgpack.packb({"inline": [list(args), {}],
+                                         "v": 1}, use_bin_type=True)
+                return (_struct.pack("<I", len(header)) + header, [], [])
+            except (OverflowError, ValueError, TypeError):
+                pass  # e.g. an int beyond 64-bit: take the pickle path
         promoted_args = []
         for a in args:
             promoted_args.append(self._promote_arg(a))
@@ -1674,6 +1820,16 @@ class Worker:
     # --------------------------------------------------- result delivery (owner)
 
     async def _h_task_result(self, payload, conn):
+        self._apply_task_result(payload)
+        return {}
+
+    def _apply_task_result(self, payload):
+        """Store a task's returns and wake its getters.  Thread-safe
+        (memory store / refcounter / result event all take their own
+        locks): the asyncio handler above and the direct lane's
+        delivery thread (direct.py) both land here — the latter is what
+        lets a leased round trip complete without ever scheduling onto
+        the io loop."""
         task_hex = payload["task_id"]
         state = self.pending_tasks.get(task_hex)
         for ret in payload["returns"]:
@@ -1696,15 +1852,19 @@ class Worker:
                     state.spec.get("retry_exceptions"):
                 state.retries_left -= 1
                 self._bump_attempt(state)
-                protocol.spawn(
-                    self._retry(state))
-                return {}
+                self.io.run_async(self._retry(state))
+                return
             state.done = True
             state.result_event.set()
             for hex_ref, _ in state.spec.get("arg_refs", []):
                 self.reference_counter.remove_submitted(
                     ObjectID.from_hex(hex_ref))
-        return {}
+            # terminal: drop the tracking entry (the result lives in the
+            # memory store / plasma, lineage lives in the refcounter's
+            # table). Without this the dict grew one spec+state per task
+            # for the process lifetime — real memory AND a growing gen-2
+            # GC sweep that visibly decayed sustained task throughput.
+            self.pending_tasks.pop(task_hex, None)
 
     async def _h_task_failed(self, payload, conn):
         """The raylet reports the executing worker died mid-task."""
@@ -1902,7 +2062,10 @@ class Worker:
         owner = spec["owner_address"]
         returns = []
         app_error = False
-        from ray_tpu.util import timeline as _timeline
+        global _timeline
+        if _timeline is None:
+            from ray_tpu.util import timeline as _tl
+            _timeline = _tl
         _t0 = time.time()
         _task_err: Optional[str] = None
         tev.emit(task_hex, tev.RUNNING, name=spec.get("fn_name"),
@@ -1971,9 +2134,13 @@ class Worker:
         if reply is not None:
             # leased task: the RPC reply carries the result (no owner
             # notify, no task_done — the lease holds the resources)
-            loop, fut = reply
             result = {"task_id": task_hex, "returns": returns,
                       "app_error": app_error}
+            if reply == DIRECT_REPLY:
+                # direct lane: the caller (direct.py's one-thread
+                # recv→execute→reply loop) frames and sends this itself
+                return result
+            loop, fut = reply
             loop.call_soon_threadsafe(
                 lambda: fut.done() or fut.set_result(result))
             return

@@ -13,6 +13,10 @@ from typing import Any, Dict, Optional
 from ray_tpu._private.worker import ObjectRef, global_worker
 from ray_tpu.common.options import validate_options
 
+# bound lazily (ray_tpu.util imports back into the package); cached —
+# a per-call ``from ... import client_mode`` showed up in submit profiles
+_client_mode = None
+
 
 class RemoteFunction:
     def __init__(self, fn, default_opts: Dict[str, Any]):
@@ -50,8 +54,11 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs, self._default_opts)
 
     def _remote(self, args, kwargs, opts: Dict[str, Any]):
-        from ray_tpu.util.client.worker import client_mode
-        c = client_mode()
+        global _client_mode
+        if _client_mode is None:
+            from ray_tpu.util.client.worker import client_mode
+            _client_mode = client_mode
+        c = _client_mode()
         if c is not None and c.connected:
             return c.submit_fn(self._fn, args, kwargs, opts)
         w = global_worker()

@@ -1,0 +1,338 @@
+"""Native RPC frame pump (src/rpccore/) + direct-execution lane.
+
+Covers the PR-15 perf plane (docs/WIRE_PROTOCOL.md "Implementations"):
+the pump itself (framing, batching, close semantics), selection and
+fallback rules (RTPU_NATIVE_RPC=0, library load failure), and the
+direct lane end-to-end — correctness of results/errors/plasma returns,
+worker-death failover, and idle lease release.  Byte-level conformance
+vectors live in test_wire_conformance.py; chaos frame faults against
+the pump live in test_chaos.py.
+"""
+
+import os
+import socket
+import tempfile
+import threading
+import time
+
+import msgpack
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol, rpccore
+
+
+pytestmark = pytest.mark.skipif(
+    rpccore._lib() is None,
+    reason="native rpc library unavailable on this host")
+
+
+# ------------------------------------------------------------- pump units
+
+
+def _mk_pair():
+    srv, cli = rpccore.Pump(), rpccore.Pump()
+    path = tempfile.mktemp(suffix=".sock")
+    srv.listen(path)
+    cid = cli.dial(path)
+    return srv, cli, cid, path
+
+
+def _close(*pumps):
+    for p in pumps:
+        p.shutdown()
+        p.destroy()
+
+
+def _first_frames(pump, n=1, timeout_s=5):
+    out = []
+    deadline = time.monotonic() + timeout_s
+    while len(out) < n and time.monotonic() < deadline:
+        for cid, kind, body in pump.next_batch(200) or []:
+            if kind == rpccore.KIND_FRAME:
+                out.append((cid, body))
+    return out
+
+
+def test_pump_echo_roundtrip():
+    srv, cli, cid, path = _mk_pair()
+    try:
+        body = msgpack.packb([0, 1, "ping", {}], use_bin_type=True)
+        assert cli.send(cid, body)
+        (scid, got), = _first_frames(srv)
+        assert got == body
+        assert srv.send(scid, got)
+        (_, back), = _first_frames(cli)
+        assert back == body
+    finally:
+        _close(srv, cli)
+        os.unlink(path)
+
+
+def test_pump_delivers_pipelined_frames_in_order_and_batched():
+    """Many frames written back-to-back arrive in order, and the pump
+    coalesces them: the consumer sees multi-frame batches and the
+    socket was drained with fewer reads than frames."""
+    srv, cli, cid, path = _mk_pair()
+    try:
+        n = 200
+        for i in range(n):
+            assert cli.send(cid, msgpack.packb(i))
+        got = _first_frames(srv, n)
+        assert [msgpack.unpackb(b) for _, b in got] == list(range(n))
+        stats = srv.stats()
+        assert stats["frames_in"] == n
+        # coalescing proof: the reader pulled multiple frames per recv
+        assert stats["read_calls"] < n
+    finally:
+        _close(srv, cli)
+        os.unlink(path)
+
+
+def test_pump_close_event_and_dead_send():
+    srv, cli, cid, path = _mk_pair()
+    try:
+        assert cli.send(cid, b"x")
+        _first_frames(srv, 1)
+        cli.close_conn(cid)
+        deadline = time.monotonic() + 5
+        closed = False
+        while time.monotonic() < deadline and not closed:
+            for _, kind, _b in srv.next_batch(200) or []:
+                closed = closed or kind == rpccore.KIND_CLOSED
+        assert closed
+        assert cli.send(cid, b"y") is False  # poisoned, not crashed
+    finally:
+        _close(srv, cli)
+        os.unlink(path)
+
+
+def test_pump_wake_bounces_next_batch():
+    p = rpccore.Pump()
+    try:
+        got = []
+
+        def wait():
+            got.append(p.next_batch(5000))
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        p.wake()
+        t.join(2)
+        assert not t.is_alive()
+        assert got and got[0] and got[0][0][1] == rpccore.KIND_WAKE
+    finally:
+        _close(p)
+
+
+def test_env_gate():
+    old = os.environ.get("RTPU_NATIVE_RPC")
+    try:
+        os.environ["RTPU_NATIVE_RPC"] = "0"
+        assert not rpccore.env_enabled()
+        assert not rpccore.available()
+        os.environ["RTPU_NATIVE_RPC"] = "1"
+        assert rpccore.env_enabled()
+        os.environ.pop("RTPU_NATIVE_RPC")
+        assert rpccore.env_enabled()  # default ON
+    finally:
+        if old is None:
+            os.environ.pop("RTPU_NATIVE_RPC", None)
+        else:
+            os.environ["RTPU_NATIVE_RPC"] = old
+
+
+# ------------------------------------------------- selection and fallback
+
+
+def test_forced_fallback_env(monkeypatch):
+    """RTPU_NATIVE_RPC=0 forces the pure-Python path end-to-end: no
+    direct client in the driver, no direct lane in the workers, tasks
+    still run (through the asyncio lease pool)."""
+    monkeypatch.setenv("RTPU_NATIVE_RPC", "0")
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=128 * 1024 * 1024)
+    try:
+        from ray_tpu._private import worker as wmod
+        assert wmod._global_worker._direct_client is None
+        assert wmod._global_worker.direct_address == ""
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert [ray_tpu.get(f.remote(i), timeout=60) for i in range(5)] \
+            == [1, 2, 3, 4, 5]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_graceful_fallback_when_library_absent(monkeypatch):
+    """A failed library build/load must leave the runtime fully
+    functional on the asyncio path (the ISSUE's hard fallback rule)."""
+    monkeypatch.setattr(rpccore, "_LIB", None)
+    monkeypatch.setattr(rpccore, "_LIB_FAILED", True)
+    assert not rpccore.available()
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=128 * 1024 * 1024)
+    try:
+        from ray_tpu._private import worker as wmod
+        assert wmod._global_worker._direct_client is None
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------- direct lane e2e
+
+
+@pytest.fixture()
+def native_cluster(monkeypatch):
+    monkeypatch.setenv("RTPU_NATIVE_RPC", "1")
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                 object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _direct_client():
+    from ray_tpu._private import worker as wmod
+    return wmod._global_worker._direct_client
+
+
+def test_direct_lane_carries_unary_tasks(native_cluster):
+    @ray_tpu.remote
+    def f(x, y=0):
+        return x + y
+
+    # warm the lease, then verify results and that the native lane —
+    # not the asyncio pool — carried them
+    assert ray_tpu.get(f.remote(1), timeout=60) == 1
+    before = _direct_client().submitted
+    vals = [ray_tpu.get(f.remote(i, y=i), timeout=60) for i in range(20)]
+    assert vals == [2 * i for i in range(20)]
+    assert _direct_client().submitted >= before + 20
+
+
+def test_direct_lane_app_errors_and_retry_exceptions(native_cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("direct-lane boom")
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(boom.remote(), timeout=60)
+    assert "direct-lane boom" in str(ei.value)
+
+    # retry_exceptions rides the same reply envelope
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky(path):
+        import os as _os
+        if not _os.path.exists(path):
+            with open(path, "w") as fh:
+                fh.write("1")
+            raise RuntimeError("first attempt fails")
+        return "ok"
+
+    flag = tempfile.mktemp()
+    try:
+        assert ray_tpu.get(flaky.remote(flag), timeout=60) == "ok"
+    finally:
+        if os.path.exists(flag):
+            os.unlink(flag)
+
+
+def test_direct_lane_plasma_returns_zero_copy(native_cluster):
+    """Large returns from a direct-lane task ride plasma (the reply
+    carries a descriptor, not bytes) and come back intact."""
+    @ray_tpu.remote
+    def big():
+        return np.arange(500_000, dtype=np.int64)  # 4 MB > inline cap
+
+    out = ray_tpu.get(big.remote(), timeout=60)
+    assert out.shape == (500_000,) and out[123456] == 123456
+
+
+def test_direct_lane_worker_death_fails_over(native_cluster):
+    """SIGKILL the executing worker mid-direct-task: the severed pump
+    connection resubmits the in-flight task through the batched raylet
+    path (at-least-once, same contract as the asyncio lease lane)."""
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        import os as _os
+        if not _os.path.exists(path):
+            with open(path, "w") as fh:
+                fh.write("1")
+            _os._exit(1)  # hard death, no cleanup
+        return "survived"
+
+    flag = tempfile.mktemp()
+    try:
+        assert ray_tpu.get(die_once.remote(flag), timeout=90) == "survived"
+    finally:
+        if os.path.exists(flag):
+            os.unlink(flag)
+
+
+def test_direct_lease_idle_release(native_cluster):
+    """An idle direct lease releases within the idle window so it stops
+    pinning node capacity (same policy as the asyncio pool)."""
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    dc = _direct_client()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(not pool for pool in dc.pools.values()):
+            break
+        time.sleep(0.25)
+    assert all(not pool for pool in dc.pools.values()), dc.pools
+
+
+def test_direct_server_answers_hello_and_ping(native_cluster):
+    """The direct socket speaks the standard wire protocol: __hello__
+    negotiation and ping work against it from a raw client pump."""
+    from ray_tpu._private import schema
+    from ray_tpu._private import worker as wmod
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    # find the worker's direct socket from the session dir instead of
+    # relying on pool state (the lease may have idled away)
+    session = wmod._global_worker.session_dir
+    socks = [f for f in os.listdir(session) if f.endswith(".direct.sock")]
+    assert socks, "no direct sockets registered"
+    cli = rpccore.Pump()
+    try:
+        cid = cli.dial(os.path.join(session, socks[0]))
+        cli.send(cid, msgpack.packb(
+            [protocol.REQUEST, 1, "__hello__", schema.hello_payload()],
+            use_bin_type=True))
+        cli.send(cid, msgpack.packb(
+            [protocol.REQUEST, 2, "ping", {}], use_bin_type=True))
+        replies = {}
+        deadline = time.monotonic() + 10
+        while len(replies) < 2 and time.monotonic() < deadline:
+            for _cid, kind, body in cli.next_batch(200) or []:
+                if kind != rpccore.KIND_FRAME:
+                    continue
+                mtype, seq, method, payload = msgpack.unpackb(
+                    body, raw=False)
+                replies[seq] = (mtype, payload)
+        assert replies[1][0] == protocol.REPLY
+        assert replies[1][1]["protocol_version"][0] == \
+            schema.PROTOCOL_VERSION[0]
+        assert replies[2][0] == protocol.REPLY
+        assert replies[2][1]["mode"] == "worker"
+    finally:
+        _close(cli)

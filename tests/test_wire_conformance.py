@@ -4,32 +4,87 @@ Pins the byte-exact framing a second-language client implements — the
 JVM-less stand-in for a Java worker conformance suite (the C++ client
 in src/cpp_client implements the same bytes; reference analogue: the
 protobuf golden files a .proto change would break).
+
+Every golden vector runs against BOTH framer implementations
+(WIRE_PROTOCOL.md "Implementations"): the Python asyncio one
+(protocol.pack_frame) and the native pump (src/rpccore/ via
+ray_tpu/_private/rpccore.py) — the native check pushes the raw vector
+bytes through a real pump socket in both directions and asserts the
+on-wire bytes are identical.
 """
 
+import os
+import socket
 import struct
+import tempfile
 
 import msgpack
+import pytest
 
-from ray_tpu._private import protocol, schema
+from ray_tpu._private import protocol, rpccore, schema
 
 
-def test_frame_layout_golden_vectors():
+def _native_roundtrip(frame: bytes) -> None:
+    """Assert the native pump (a) delivers exactly the vector's body
+    when the vector's bytes arrive on the wire and (b) produces exactly
+    the vector's bytes when asked to send that body."""
+    if rpccore._lib() is None:
+        pytest.skip("native rpc library unavailable on this host")
+    pump = rpccore.Pump()
+    path = tempfile.mktemp(suffix=".sock")
+    pump.listen(path)
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        raw.connect(path)
+        raw.settimeout(10)
+        # wire -> pump: the pump must deframe to exactly the body
+        raw.sendall(frame)
+        evs = []
+        for _ in range(100):
+            evs = pump.next_batch(timeout_ms=200)
+            if evs:
+                break
+        assert evs and evs[0][1] == rpccore.KIND_FRAME
+        cid, _, body = evs[0]
+        assert body == frame[4:]
+        # pump -> wire: sending the body must produce the exact frame
+        assert pump.send(cid, body)
+        got = b""
+        while len(got) < len(frame):
+            got += raw.recv(len(frame) - len(got))
+        assert got == frame
+    finally:
+        raw.close()
+        pump.shutdown()
+        pump.destroy()
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def _check_vector(impl: str, body_list, hex_frame: str) -> None:
+    frame = protocol.pack_frame(body_list)
+    assert frame.hex() == hex_frame
+    if impl == "native":
+        _native_roundtrip(frame)
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_frame_layout_golden_vectors(impl):
     # NOTIFY task_done
-    frame = protocol.pack_frame(
-        [protocol.NOTIFY, None, "task_done", {"task_id": "ab"}])
-    assert frame.hex() == (
-        "19000000"  # uint32-le length 25
-        "9403c0a97461736b5f646f6e6581a77461736b5f6964a26162")
+    _check_vector(impl,
+                  [protocol.NOTIFY, None, "task_done", {"task_id": "ab"}],
+                  "19000000"  # uint32-le length 25
+                  "9403c0a97461736b5f646f6e6581a77461736b5f6964a26162")
     # REQUEST seq=1 ping {}
-    frame = protocol.pack_frame([protocol.REQUEST, 1, "ping", {}])
-    assert frame.hex() == "09000000940001a470696e6780"
+    _check_vector(impl, [protocol.REQUEST, 1, "ping", {}],
+                  "09000000940001a470696e6780")
     # REPLY seq=1 {"ok": true}
-    frame = protocol.pack_frame(
-        [protocol.REPLY, 1, "ping", {"ok": True}])
-    assert frame.hex() == "0d000000940101a470696e6781a26f6bc3"
+    _check_vector(impl, [protocol.REPLY, 1, "ping", {"ok": True}],
+                  "0d000000940101a470696e6781a26f6bc3")
 
 
-def test_dag_channel_frame_golden_vectors():
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_dag_channel_frame_golden_vectors(impl):
     """Compiled-DAG channel frames (1.5; docs/WIRE_PROTOCOL.md §1.5 +
     docs/COMPILED_DAGS.md). They ride dedicated channel sockets but use
     the same framing, so a second-language stage implements these exact
@@ -41,17 +96,65 @@ def test_dag_channel_frame_golden_vectors():
         "20000000"
         "9403c0a8"
         "6461675f6578656384a164a561622e6731a17400a17301a162c40101")
+    if impl == "native":
+        _native_roundtrip(frame)
     frame = pack_dag_frame("dag_result", {"d": "ab.g1", "s": 1, "i": 0,
                                           "ae": False, "b": b"\x02"})
     assert frame.hex() == (
         "26000000"
         "9403c0aa6461675f726573756c7485a164a561622e6731"
         "a17301a16900a26165c2a162c40102")
+    if impl == "native":
+        _native_roundtrip(frame)
     for method in ("dag_channel_open", "dag_channel_close",
                    "dag_register", "dag_unregister", "dag_stage_error",
                    "dag_peer_down", "dag_exec", "dag_result"):
         assert method in schema.SCHEMAS, method
     assert schema.PROTOCOL_VERSION >= (1, 5)
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_leased_task_frame_both_framers(impl):
+    """The direct-execution lane's hot frame (1.7): a leased_task
+    REQUEST must be byte-identical from either implementation — the
+    native pump frames the same msgpack body the asyncio path packs."""
+    body = [protocol.REQUEST, 7, "leased_task",
+            {"spec": {"task_id": "ab", "fn_key": "k"}}]
+    frame = protocol.pack_frame(body)
+    (n,) = struct.unpack("<I", frame[:4])
+    assert n == len(frame) - 4
+    assert msgpack.unpackb(frame[4:], raw=False) == [
+        0, 7, "leased_task", {"spec": {"task_id": "ab", "fn_key": "k"}}]
+    if impl == "native":
+        _native_roundtrip(frame)
+
+
+def test_native_framer_rejects_oversized_frames():
+    """A length prefix above _MAX_FRAME is a protocol error in BOTH
+    implementations: read_frame raises, the native pump drops the
+    connection."""
+    if rpccore._lib() is None:
+        pytest.skip("native rpc library unavailable on this host")
+    pump = rpccore.Pump()
+    path = tempfile.mktemp(suffix=".sock")
+    pump.listen(path)
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        raw.connect(path)
+        raw.sendall(struct.pack("<I", protocol._MAX_FRAME + 1) + b"x")
+        evs = []
+        for _ in range(100):
+            evs = pump.next_batch(timeout_ms=200)
+            if evs:
+                break
+        # the pump closes the peer instead of allocating 256MB+
+        assert evs and evs[0][1] == rpccore.KIND_CLOSED
+    finally:
+        raw.close()
+        pump.shutdown()
+        pump.destroy()
+        if os.path.exists(path):
+            os.unlink(path)
 
 
 def test_frame_roundtrip_and_length_prefix():

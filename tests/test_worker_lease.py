@@ -29,6 +29,16 @@ def _driver():
     return wmod._global_worker
 
 
+def _lease_engaged(w) -> bool:
+    """True when the ACTIVE lease lane holds a live lease: the native
+    direct pool when RTPU_NATIVE_RPC is on and the pump loaded, the
+    asyncio pool otherwise (both implement the same lease contract)."""
+    dc = w._direct_client
+    if dc is not None and dc.usable():
+        return any(L.addr for pool in dc.pools.values() for L in pool)
+    return any(L.addr for pool in w._worker_leases.values() for L in pool)
+
+
 def test_lease_lane_engages_and_results_are_correct(one_cpu_cluster):
     @ray_tpu.remote
     def f(x):
@@ -37,11 +47,9 @@ def test_lease_lane_engages_and_results_are_correct(one_cpu_cluster):
     assert ray_tpu.get(f.remote(1)) == 2
     deadline = time.time() + 10
     w = _driver()
-    while time.time() < deadline and not any(
-            L.addr for pool in w._worker_leases.values() for L in pool):
+    while time.time() < deadline and not _lease_engaged(w):
         ray_tpu.get(f.remote(0))
-    pools = w._worker_leases
-    assert any(L.addr for pool in pools.values() for L in pool), \
+    assert _lease_engaged(w), \
         "lease never engaged for a qualifying CPU task"
     # correctness through the leased path, including app errors
     assert ray_tpu.get([f.remote(i) for i in range(50)]) == \
@@ -82,11 +90,9 @@ def test_idle_lease_releases_capacity(one_cpu_cluster):
     ray_tpu.get([f.remote() for _ in range(10)])
     w = _driver()
     deadline = time.time() + 15
-    while time.time() < deadline and any(
-            L.addr for pool in w._worker_leases.values() for L in pool):
+    while time.time() < deadline and _lease_engaged(w):
         time.sleep(0.25)
-    assert not any(L.addr for pool in w._worker_leases.values()
-                   for L in pool), "idle lease still pinning capacity"
+    assert not _lease_engaged(w), "idle lease still pinning capacity"
     # capacity is back: a fresh non-leasable task can run
     @ray_tpu.remote(max_retries=0)
     def g():
