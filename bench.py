@@ -1269,7 +1269,15 @@ def _llm_bench_main():
     The toy adapter emulates model cost (3 ms/step + 0.2 ms/sequence;
     0.05 ms/prefill token): per-step cost is mostly FIXED, which is
     exactly the regime where continuous batching wins — a static batch
-    runs its stragglers nearly alone while admitted work waits."""
+    runs its stragglers nearly alone while admitted work waits.
+
+    Two fleet-serving sections ride along (docs/LLM_SERVING.md):
+    radix prefix cache (warm vs cold under a Zipf-skewed
+    shared-system-prompt tenant mix; gates >= 1.3x tokens/s, no-worse
+    TTFT p99, identical outputs, hit ratio reported) and
+    prefill/decode disaggregation (1 prefill + 1 decode engine with
+    KV handoff vs 2 unified engines round-robin; gate: disagg TPOT
+    p99 <= unified — decode never pays a prefill bubble)."""
     _force_cpu_platform()
     import random
     import threading
@@ -1356,6 +1364,205 @@ def _llm_bench_main():
     cont = run("continuous")
     static = run("static")
 
+    # ---- radix prefix cache: Zipf-skewed tenants share system prompts
+    # Prefill cost dominates here (1 ms/prompt token): skipping the
+    # cached shared-prefix pages is a direct throughput win.  The SAME
+    # arrival schedule runs warm (radix cache on) and cold (off);
+    # greedy decoding, so the token streams must be identical.
+    p_duration = float(os.environ.get("LLM_BENCH_PREFIX_DURATION_S",
+                                      1.5 if smoke else 6.0))
+    p_rate = 16.0 if smoke else 30.0
+    n_tenants = 6
+    prng = random.Random(77)
+    zipf_w = [1.0 / (i + 1) ** 1.4 for i in range(n_tenants)]
+    prefixes = [[random.Random(f"sys:{i}").randrange(256)
+                 for _ in range(48)] for i in range(n_tenants)]
+    p_arrivals = []
+    t = 0.0
+    while True:
+        t += prng.expovariate(p_rate)
+        if t >= p_duration:
+            break
+        tenant = prng.choices(range(n_tenants), weights=zipf_w)[0]
+        suffix = [prng.randrange(256)
+                  for _ in range(prng.randint(6, 14))]
+        p_arrivals.append((t, prefixes[tenant] + suffix,
+                           prng.randint(6, 12)))
+    p_prompt_tokens = sum(len(a[1]) for a in p_arrivals)
+
+    def run_prefix(enable):
+        eng = LLMEngine(
+            ToyAdapter(step_delay_s=0.001, per_seq_delay_s=0.0001,
+                       per_prefill_token_delay_s=0.001),
+            EngineConfig(max_running=8, max_waiting=100000,
+                         max_prefill_tokens=512, num_blocks=4096,
+                         block_size=16, max_seq_len=512,
+                         enable_prefix_cache=enable))
+        outs = [None] * len(p_arrivals)
+        ttfts = [0.0] * len(p_arrivals)
+
+        def consume(i, sched_abs, sid):
+            cur, toks, first = 0, [], None
+            while True:
+                ch = eng.poll(sid, cur, max_wait_s=30.0)
+                if ch["tokens"] and first is None:
+                    first = time.time()
+                toks.extend(ch["tokens"])
+                cur = ch["cursor"]
+                if ch["done"]:
+                    break
+            outs[i] = toks
+            ttfts[i] = max(0.0, (first or time.time()) - sched_abs)
+
+        threads = []
+        t0 = time.time()
+        for i, (ta, prompt, ntok) in enumerate(p_arrivals):
+            delay = t0 + ta - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            sid = eng.add_request(
+                prompt, SamplingParams(max_new_tokens=ntok))
+            th = threading.Thread(target=consume,
+                                  args=(i, t0 + ta, sid))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        makespan = time.time() - t0
+        hit_tokens = int(eng.metrics().get("cache_hit_tokens_total", 0))
+        eng.stop()
+        q = sorted(ttfts)
+        tokens = sum(len(o) for o in outs)
+        return {"tokens_per_s": round(tokens / makespan, 2),
+                "ttft_p99_ms": round(
+                    q[min(len(q) - 1, int(0.99 * len(q)))] * 1e3, 2),
+                "hit_tokens": hit_tokens, "outs": outs}
+
+    warm = run_prefix(True)
+    cold = run_prefix(False)
+    prefix_ratio = round(warm["tokens_per_s"]
+                         / max(cold["tokens_per_s"], 1e-9), 2)
+    prefix_hit_ratio = round(warm["hit_tokens"]
+                             / max(p_prompt_tokens, 1), 3)
+
+    # ---- prefill/decode disaggregation vs unified, same 2-engine budget
+    # Disagg: one prefill-role engine hands the prompt KV (inline blob —
+    # the serve path ships the identical blob over plasmax ring slots)
+    # to one decode-role engine, which never runs a prefill.  Unified:
+    # two engines round-robin, each interleaving prefills into its
+    # decode batch.  The prefill bubbles (~50 ms at these costs) land
+    # in the unified engines' inter-token gaps — TPOT p99 is the gate.
+    d_duration = 1.5 if smoke else 6.0
+    d_rate = 5.0 if smoke else 8.0
+    drng = random.Random(99)
+    d_arrivals = []
+    t = 0.0
+    while True:
+        t += drng.expovariate(d_rate)
+        if t >= d_duration:
+            break
+        plen = 32 + drng.randint(8, 16)
+        d_arrivals.append((t, [drng.randrange(256) for _ in range(plen)],
+                           drng.randint(8, 16)))
+
+    def _mk_eng():
+        return LLMEngine(
+            ToyAdapter(step_delay_s=0.001, per_seq_delay_s=0.0001,
+                       per_prefill_token_delay_s=0.001),
+            EngineConfig(max_running=8, max_waiting=100000,
+                         max_prefill_tokens=512, num_blocks=4096,
+                         block_size=16, max_seq_len=512))
+
+    def _drain_timed(eng, sid, first=None):
+        """Poll a stream to completion; returns (t_first, t_last, n)."""
+        cur, n, last = 0, 0, None
+        while True:
+            ch = eng.poll(sid, cur, max_wait_s=30.0)
+            if ch["tokens"]:
+                if first is None:
+                    first = time.time()
+                last = time.time()
+                n += len(ch["tokens"])
+            cur = ch["cursor"]
+            if ch["done"]:
+                break
+        return first, last or first or time.time(), n
+
+    def run_disagg():
+        pre, dec = _mk_eng(), _mk_eng()
+        rows = []
+        lock = threading.Lock()
+
+        def one(sched_abs, prompt, ntok):
+            sp = SamplingParams(max_new_tokens=ntok)
+            sid = pre.prefill_export(prompt, sp)
+            t_first, _, _ = _drain_timed(pre, sid)
+            export = pre.take_export(sid) or {}
+            first_tok = export.get("first_token")
+            if first_tok is None:
+                return
+            sid2 = dec.adopt_request(prompt, int(first_tok),
+                                     export.get("kv"), sp)
+            _, t_last, n = _drain_timed(dec, sid2, first=t_first)
+            with lock:
+                rows.append((max(0.0, t_first - sched_abs),
+                             (t_last - t_first) / max(n - 1, 1), n))
+
+        threads = []
+        t0 = time.time()
+        for (ta, prompt, ntok) in d_arrivals:
+            delay = t0 + ta - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one,
+                                  args=(t0 + ta, prompt, ntok))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        pre.stop()
+        dec.stop()
+        return rows
+
+    def run_unified_pair():
+        engs = [_mk_eng(), _mk_eng()]
+        rows = []
+        lock = threading.Lock()
+
+        def one(eng, sched_abs, prompt, ntok):
+            sid = eng.add_request(
+                prompt, SamplingParams(max_new_tokens=ntok))
+            t_first, t_last, n = _drain_timed(eng, sid)
+            with lock:
+                rows.append((max(0.0, t_first - sched_abs),
+                             (t_last - t_first) / max(n - 1, 1), n))
+
+        threads = []
+        t0 = time.time()
+        for i, (ta, prompt, ntok) in enumerate(d_arrivals):
+            delay = t0 + ta - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(
+                target=one, args=(engs[i % 2], t0 + ta, prompt, ntok))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        for e in engs:
+            e.stop()
+        return rows
+
+    def _q99(rows, idx):
+        vals = sorted(r[idx] for r in rows)
+        if not vals:
+            return 0.0
+        return round(vals[min(len(vals) - 1,
+                              int(0.99 * len(vals)))] * 1e3, 2)
+
+    disagg_rows = run_disagg()
+    unified_rows = run_unified_pair()
+
     # paged-attention kernel numerics vs the whole-kv reference
     # (tier-1 re-asserts this; the bench records the number)
     import numpy as np
@@ -1395,6 +1602,26 @@ def _llm_bench_main():
         "gate_ttft_ok":
             cont["ttft_p99_ms"] <= static["ttft_p99_ms"],
         "gate_numerics_ok": max_err < 1e-4,
+        # radix prefix cache (warm vs cold, same Zipf tenant schedule)
+        "prefix_requests": len(p_arrivals),
+        "prefix_warm_tokens_per_s": warm["tokens_per_s"],
+        "prefix_cold_tokens_per_s": cold["tokens_per_s"],
+        "prefix_tokens_per_s_ratio": prefix_ratio,
+        "prefix_warm_ttft_p99_ms": warm["ttft_p99_ms"],
+        "prefix_cold_ttft_p99_ms": cold["ttft_p99_ms"],
+        "prefix_hit_ratio": prefix_hit_ratio,
+        "gate_prefix_throughput_ok": prefix_ratio >= 1.3,
+        "gate_prefix_ttft_ok":
+            warm["ttft_p99_ms"] <= cold["ttft_p99_ms"],
+        "gate_prefix_identical_ok": warm["outs"] == cold["outs"],
+        # prefill/decode disaggregation vs unified (2 engines each)
+        "disagg_requests": len(d_arrivals),
+        "disagg_ttft_p99_ms": _q99(disagg_rows, 0),
+        "unified_ttft_p99_ms": _q99(unified_rows, 0),
+        "disagg_tpot_p99_ms": _q99(disagg_rows, 1),
+        "unified_tpot_p99_ms": _q99(unified_rows, 1),
+        "gate_disagg_tpot_ok":
+            _q99(disagg_rows, 1) <= _q99(unified_rows, 1),
     }
     print(json.dumps(out), flush=True)
 

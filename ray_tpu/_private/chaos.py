@@ -68,6 +68,17 @@ Sites wired through the runtime:
                                     specific compiled-DAG stage at its
                                     N-th execution; method filter = the
                                     stage id as a string)
+    llm.kv_ship                     drop | delay | reset | corrupt
+                                    (disaggregated LLM serving's
+                                    prefill→decode KV handoff,
+                                    serve/llm/disagg.py: fires on the
+                                    receive side mid-handoff; ``drop``
+                                    loses the frame, ``corrupt`` flips a
+                                    byte so the CRC rejects it, ``reset``
+                                    raises KVShipError — every op
+                                    degrades to a decode-side re-prefill
+                                    with no leaked KV pages; method
+                                    filter = __llm_adopt__)
 
 Every fired fault is appended to the chaos log (``RTPU_CHAOS_LOG`` path;
 JSONL of ``{n, site, op, method, seq, ts}`` — everything except ``ts``

@@ -157,7 +157,10 @@ def reconcile(scenario, client_ledger: Dict[str, List[str]],
             bad.append(f"{name}: ledger truncated (raise "
                        f"RTPU_SERVE_REQUEST_LOG_MAX)")
             continue
-        admitted = sum(1 for r in recs if r[1] in ("ok", "error"))
+        # "prefill" rows are the disagg two-hop's internal first hop —
+        # admitted work (the counter saw it) but not a completion
+        admitted = sum(1 for r in recs
+                       if r[1] in ("ok", "error", "prefill"))
         shed = sum(1 for r in recs if r[1] == "shed")
         if admitted != m.get("total_requests") or \
                 shed != m.get("total_shed"):
@@ -346,6 +349,73 @@ def reconcile(scenario, client_ledger: Dict[str, List[str]],
     elif is_llm:
         checks.append(_check("llm-tokens", True,
                              "skipped (no client token counts)"))
+
+    # C11: prefix-cache accounting (LLM workload) — two exact joins.
+    # (a) Per live replica, the engine's cache_hit_tokens_total counter
+    #     equals the sum of the cached-token column over that replica's
+    #     token ledger (counter and ledger are written by the same
+    #     commit path; drift means lost or double-counted hits).
+    # (b) Per completed request, the engine ledger's recorded prompt
+    #     length equals what the client actually sent — the cache-skip
+    #     path must not silently shorten (or lengthen) a prompt.
+    client_prompts = server_view.get("llm_client_prompts")
+    if is_llm and client_prompts is not None and client_tokens is not None:
+        llm_metrics = server_view.get("llm_metrics") or {}
+        ledgers_by_replica: Dict[str, List[Any]] = {}
+        for led in server_view.get("llm_ledgers") or []:
+            ledgers_by_replica.setdefault(
+                str(led.get("replica")), []).extend(
+                    led.get("records") or [])
+        drifted = []
+        for hex_id, m in llm_metrics.items():
+            counter = m.get("cache_hit_tokens_total")
+            if counter is None:
+                continue
+            ledger_sum = sum(
+                int(rec[4]) for rec in ledgers_by_replica.get(hex_id, [])
+                if len(rec) > 4)
+            if int(counter) != ledger_sum:
+                drifted.append(f"{hex_id[:8]}: counter {counter} vs "
+                               f"ledger {ledger_sum}")
+        rows_by_rid: Dict[str, List[Any]] = {}
+        for recs in ledgers_by_replica.values():
+            for rec in recs:
+                if rec[0] is not None:
+                    rows_by_rid.setdefault(rec[0], []).append(rec)
+        bad_prompt = []
+        for rid in sorted(ok_rids):
+            plen = client_prompts.get(rid)
+            want = client_tokens.get(rid)
+            if plen is None or want is None:
+                continue  # C10 owns missing-ledger accounting
+            rows = [rec for rec in rows_by_rid.get(rid, ())
+                    if len(rec) > 3 and int(rec[1]) == int(want)]
+            if rows and not any(int(rec[3]) == int(plen)
+                                for rec in rows):
+                bad_prompt.append(
+                    f"{rid}: client prompt {plen} vs engine "
+                    f"{sorted(int(r[3]) for r in rows)}")
+        hit_total = sum(
+            int(m.get("cache_hit_tokens_total") or 0)
+            for m in llm_metrics.values())
+        if tolerate and (drifted or bad_prompt):
+            checks.append(_check(
+                "llm-cache-hit", True,
+                f"{len(drifted)} counter drifts, {len(bad_prompt)} "
+                f"prompt mismatches with SIGKILLed replicas "
+                f"(tolerated)"))
+        else:
+            checks.append(_check(
+                "llm-cache-hit", not drifted and not bad_prompt,
+                f"{hit_total} cache-hit tokens across "
+                f"{len(llm_metrics)} live engines; "
+                f"{len(drifted)} counter/ledger drifts"
+                + (f" e.g. {drifted[:2]}" if drifted else "")
+                + (f"; {len(bad_prompt)} prompt-length mismatches, "
+                   f"e.g. {bad_prompt[:2]}" if bad_prompt else "")))
+    elif is_llm:
+        checks.append(_check("llm-cache-hit", True,
+                             "skipped (no client prompt lengths)"))
 
     return {
         "ok": all(c["ok"] for c in checks),

@@ -114,6 +114,7 @@ def _bind_app(sc: Scenario, version: int):
                 cfg.get("max_concurrent_queries", 32)),
             max_queued_requests=cfg.get("max_queued_requests"),
             user_config={"v": version},
+            llm_roles=cfg.get("llm_roles"),
             graceful_shutdown_timeout_s=cfg.get(
                 "graceful_shutdown_timeout_s", 20.0))(LLMServer)
         return dep.bind(llm.get("model", "toy"),
@@ -449,7 +450,19 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
         from ray_tpu.serve.exceptions import StreamBrokenError
         token_counts: Dict[str, int] = {}
         first_token_at: Dict[str, float] = {}
+        prompt_lens: Dict[str, int] = {}
         tc_lock = threading.Lock()
+
+        _SYS_PROMPT_TOKENS = 32  # 2 full pages at the engine's bs=16
+
+        def _tenant_prefix(tenant: str) -> List[int]:
+            # every tenant's requests share a deterministic "system
+            # prompt": with Zipf-skewed tenancy the hot tenants' traffic
+            # is exactly the shared-prefix shape the radix prefix cache
+            # exists for (32 tokens = 2 full pages at block_size 16)
+            rng = _random.Random(f"sys:{tenant}")
+            return [rng.randrange(256)
+                    for _ in range(_SYS_PROMPT_TOKENS)]
 
         def _llm_payload(arrival: Arrival) -> Dict[str, Any]:
             # heavy-tail prompt AND output lengths from the arrival's
@@ -457,8 +470,11 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
             rng = _random.Random(f"llm:{arrival.rid}")
             plen = max(2, min(48, int(2 + arrival.size * 3)))
             ntok = max(1, min(40, int(1 + arrival.size * 2)))
-            return {"tokens": [rng.randrange(256) for _ in range(plen)],
-                    "max_new_tokens": ntok}
+            tokens = _tenant_prefix(arrival.tenant) + \
+                [rng.randrange(256) for _ in range(plen)]
+            with tc_lock:
+                prompt_lens[arrival.rid] = len(tokens)
+            return {"tokens": tokens, "max_new_tokens": ntok}
 
         def send_llm(arrival: Arrival):
             payload = _llm_payload(arrival)
@@ -631,6 +647,7 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
         if is_llm:
             with tc_lock:
                 server_view["llm_client_tokens"] = dict(token_counts)
+                server_view["llm_client_prompts"] = dict(prompt_lens)
             server_view["llm_ledgers"] = llm_ledgers
             server_view["llm_metrics"] = llm_metrics
 

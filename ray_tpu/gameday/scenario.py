@@ -266,11 +266,20 @@ def _llm_chat(seed: int = 623) -> Scenario:
     client's per-request token counts against the engines' token
     ledgers. A rolling update mid-run proves KV-aware drain under
     load: every in-flight stream finishes on the draining replicas,
-    zero sequences dropped."""
+    zero sequences dropped.
+
+    Tenancy is Zipf-skewed (6 tenants, skew 1.4) and every tenant's
+    requests share a per-tenant system prompt (runner prepends it), so
+    the radix prefix cache sees realistic shared-prefix traffic;
+    reconciliation additionally checks that the engines' cache-hit
+    token ledgers agree exactly with the client-observed prompt
+    lengths (check C11)."""
     return Scenario(
         "llm-chat", seed=seed,
-        description="streaming LLM chat, heavy-tail lengths, rolling "
-                    "update mid-run; per-token reconciliation, 0 failed",
+        description="streaming LLM chat, heavy-tail lengths, Zipf "
+                    "shared-prefix tenants, rolling update mid-run; "
+                    "per-token reconciliation, 0 failed",
+        tenants=6, tenant_skew=1.4,
         phases=[
             {"name": "warmup", "duration_s": 2.0, "shape": "steady",
              "rps": 6},
@@ -301,7 +310,60 @@ def _llm_chat(seed: int = 623) -> Scenario:
                                       "max_waiting": 64,
                                       "num_blocks": 256,
                                       "block_size": 16,
-                                      "max_seq_len": 512}},
+                                      "max_seq_len": 512,
+                                      "enable_prefix_cache": True}},
+        },
+        slo={"availability_target": 0.999,
+             "latency_target_ms": 4000.0},
+        max_workers=48,
+    )
+
+
+def _llm_chat_disagg(seed: int = 911) -> Scenario:
+    """Disaggregated LLM serving game day: the same Zipf shared-prefix
+    chat traffic as ``llm-chat``, but the fleet is split by role
+    (``llm_roles``: 1 prefill + 2 decode over 3 replicas) so every
+    admission is the router's two-hop path — ``__llm_prefill__`` on the
+    prefill replica, KV pages shipped over a plasmax ring slot,
+    ``__llm_adopt__`` rebinding them on a decode replica.  The rolling
+    update mid-run retires replicas of BOTH roles while handoffs are in
+    flight; greedy decode determinism + the re-prefill fallback mean
+    reconciliation must still balance to the token (checks C10/C11, 0
+    failed streams)."""
+    return Scenario(
+        "llm-chat-disagg", seed=seed,
+        description="disaggregated (1 prefill + 2 decode) streaming LLM "
+                    "chat, KV handoff per admission, rolling update "
+                    "mid-run; per-token reconciliation, 0 failed",
+        tenants=6, tenant_skew=1.4,
+        phases=[
+            {"name": "warmup", "duration_s": 2.0, "shape": "steady",
+             "rps": 6},
+            {"name": "chat", "duration_s": 8.0, "shape": "diurnal",
+             "min_rps": 8, "peak_rps": 18},
+            {"name": "cooldown", "duration_s": 2.0, "shape": "steady",
+             "rps": 4},
+        ],
+        actions=[
+            {"kind": "rolling_update", "t_s": 5.0},
+        ],
+        deployment={
+            "workload": "llm",
+            "num_replicas": 3,
+            "llm_roles": {"prefill": 1, "decode": 2},
+            "max_concurrent_queries": 32,
+            "max_queued_requests": 64,
+            "graceful_shutdown_timeout_s": 20.0,
+            "assign_timeout_s": 15.0,
+            "llm": {"model": "toy",
+                    "model_config": {"per_seq_delay_s": 0.0005,
+                                     "step_delay_s": 0.001},
+                    "engine_config": {"max_running": 8,
+                                      "max_waiting": 64,
+                                      "num_blocks": 256,
+                                      "block_size": 16,
+                                      "max_seq_len": 512,
+                                      "enable_prefix_cache": True}},
         },
         slo={"availability_target": 0.999,
              "latency_target_ms": 4000.0},
@@ -315,6 +377,7 @@ _BUILTIN = {
     "replica-storm": _replica_storm,
     "diurnal-soak": _diurnal_soak,
     "llm-chat": _llm_chat,
+    "llm-chat-disagg": _llm_chat_disagg,
 }
 
 

@@ -61,13 +61,29 @@ class AutoscalingPolicy:
         if not signals:
             return raw
         if c.target_tokens_per_s_per_replica:
-            raw = max(raw, float(signals.get("tokens_per_s", 0.0))
-                      / c.target_tokens_per_s_per_replica)
+            # tokens/s the fleet PRODUCED; cache-hit tokens/s (prefix
+            # cache skipping prefill work) count as served demand the
+            # fleet absorbed without compute — both are throughput the
+            # target has to cover (docs/LLM_SERVING.md)
+            served = (float(signals.get("tokens_per_s", 0.0))
+                      + float(signals.get("cache_hit_tokens_per_s", 0.0)))
+            raw = max(raw, served / c.target_tokens_per_s_per_replica)
         if c.target_kv_occupancy:
             # occupancy is per-replica-average: current fleet holding
             # occ of its pools needs current * occ / target replicas
             occ = float(signals.get("kv_occupancy", 0.0))
             raw = max(raw, current * occ / c.target_kv_occupancy)
+        per_role = signals.get("per_role")
+        if per_role and c.target_tokens_per_s_per_replica:
+            # disaggregated fleets: size each role sub-fleet for ITS
+            # load, then sum — a saturated decode tier must not hide
+            # behind idle prefill replicas in the fleet-wide mean
+            need = 0.0
+            for role_row in per_role.values():
+                need += max(1.0, math.ceil(
+                    float(role_row.get("tokens_per_s", 0.0))
+                    / c.target_tokens_per_s_per_replica))
+            raw = max(raw, need)
         return raw
 
     def get_decision(self, current_replicas: int,

@@ -239,7 +239,12 @@ class ReplicaActor:
                     prev_trace = getattr(worker.task_context, "trace",
                                          None)
                     worker.task_context.trace = exec_span.trace_ctx()
-        outcome = "ok"
+        # the disagg prefill hop is an INTERNAL sub-request: the same
+        # request id completes again on the decode replica, so ledger
+        # it under its own outcome or the per-request reconciliation
+        # join would read the pair as a duplicate completion
+        outcome = ("prefill" if method_name == "__llm_prefill__"
+                   else "ok")
         try:
             if self._is_function:
                 target = self.callable
@@ -260,7 +265,8 @@ class ReplicaActor:
             if worker is not None:
                 worker.task_context.trace = prev_trace
             if exec_span is not None:
-                exec_span.finish("ok" if outcome == "ok" else "error")
+                exec_span.finish(
+                    "error" if outcome == "error" else "ok")
             self._exec_sem.release()
             dt = time.monotonic() - t0
             with self._ongoing_lock:
@@ -344,7 +350,9 @@ class ReplicaActor:
     def get_request_log(self) -> Dict[str, Any]:
         """This replica's request ledger: every admitted/shed request
         as (request_id, outcome, latency_s), ``outcome`` in
-        ok|error|shed. ``truncated`` means the bounded log overflowed
+        ok|error|shed|prefill (``prefill`` = the disagg two-hop's
+        internal first hop — admitted work, not a client-visible
+        completion). ``truncated`` means the bounded log overflowed
         (raise ``RTPU_SERVE_REQUEST_LOG_MAX``) — per-request joins are
         then unreliable and reconciliation says so."""
         with self._ongoing_lock:

@@ -89,6 +89,9 @@ class ReplicaSet:
         self.routing_policy: Optional[str] = None  # None → env default
         self._replicas: List[Any] = []       # actor handles
         self._in_flight: Dict[str, int] = {}  # actor id hex -> count
+        # actor id hex -> "prefill"|"decode" (controller-assigned, only
+        # for disaggregated LLM deployments; empty otherwise)
+        self._roles: Dict[str, str] = {}
         # actor id hex -> {"queue_len", "ewma_s", "ts"} as reported by
         # the replica (long-poll refresh or response piggyback)
         self._reports: Dict[str, Dict[str, float]] = {}
@@ -99,7 +102,8 @@ class ReplicaSet:
 
     def update_replicas(self, replicas: List[Any],
                         max_concurrent_queries: Optional[int] = None,
-                        routing_policy: Optional[str] = None):
+                        routing_policy: Optional[str] = None,
+                        replica_roles: Optional[Dict[str, str]] = None):
         with self._cv:
             self._replicas = list(replicas)
             if max_concurrent_queries:
@@ -107,11 +111,29 @@ class ReplicaSet:
             if routing_policy is not None:
                 self.routing_policy = routing_policy
             live = {r._id_hex for r in self._replicas}
+            self._roles = {k: v for k, v in (replica_roles or {}).items()
+                           if k in live}
             self._in_flight = {k: v for k, v in self._in_flight.items()
                                if k in live}
             self._reports = {k: v for k, v in self._reports.items()
                              if k in live}
             self._cv.notify_all()
+
+    def member_ids(self) -> Set[str]:
+        with self._cv:
+            return {r._id_hex for r in self._replicas}
+
+    def role_members(self, role: str) -> Set[str]:
+        with self._cv:
+            return {k for k, v in self._roles.items() if v == role}
+
+    def disaggregated(self) -> bool:
+        """True when the controller published a role split with at
+        least one live prefill AND one live decode replica — the
+        router's cue to run the two-hop admission."""
+        with self._cv:
+            roles = set(self._roles.values())
+        return "prefill" in roles and "decode" in roles
 
     def record_report(self, replica_id: str, queue_len: float,
                       ewma_s: float = 0.0, ts: Optional[float] = None):
@@ -252,7 +274,8 @@ class Router:
                     self._sets[name] = s
                 s.update_replicas(replicas,
                                   info["max_concurrent_queries"],
-                                  info.get("routing_policy"))
+                                  info.get("routing_policy"),
+                                  info.get("replica_roles"))
             for gone in set(self._sets) - set(snapshot):
                 self._sets.pop(gone)
 
@@ -538,43 +561,147 @@ class Router:
             if sampled:
                 kwargs[TRACE_CTX_KWARG] = root.child_ctx()
         rs = self.replica_set(deployment_name)
-        exclude: Set[str] = set()
-        last_err: Optional[BaseException] = None
         try:
-            for _ in range(max(1, overload_retries + 1)):
-                replica = rs.assign(timeout=assign_timeout,
-                                    exclude=exclude)
-                ref = _call_under_span(
+            if rs.disaggregated():
+                stream = self._open_disagg(
+                    deployment_name, rs, payload, kwargs,
                     root if sampled else None,
-                    lambda: replica.handle_request_with_load.remote(
-                        "__llm_open__", (payload,), kwargs))
-                try:
-                    out = ray_tpu.get(ref, timeout=open_timeout)
-                except Exception as e:
-                    if is_overload_error(e):
-                        exclude.add(replica._id_hex)
-                        rs.record_report(replica._id_hex,
-                                         queue_len=float("inf"))
-                        last_err = e
-                        continue
-                    raise
-                finally:
-                    rs.release(replica)
-                if isinstance(out, dict) and "__serve_result__" in out:
-                    load = out.get("__serve_load__")
-                    if isinstance(load, dict):
-                        rs.record_report(replica._id_hex,
-                                         load.get("queue_len", 0),
-                                         load.get("ewma_s", 0.0),
-                                         load.get("ts"))
-                    out = out["__serve_result__"]
-                return ReplicaStream(deployment_name, replica,
-                                     out["stream_id"], root)
-            raise last_err
+                    assign_timeout=assign_timeout,
+                    open_timeout=open_timeout,
+                    overload_retries=overload_retries)
+                if stream is not None:
+                    stream._root = root
+                    return stream
+                logger.warning(
+                    "llm disagg: two-hop admission unavailable for %r; "
+                    "falling back to unified __llm_open__",
+                    deployment_name)
+            return self._open_unified(
+                deployment_name, rs, payload, kwargs,
+                root, sampled, assign_timeout=assign_timeout,
+                open_timeout=open_timeout,
+                overload_retries=overload_retries)
         except BaseException:
             if root is not None:
                 root.finish("error")
             raise
+
+    def _open_unified(self, deployment_name, rs, payload, kwargs,
+                      root, sampled, *, assign_timeout, open_timeout,
+                      overload_retries) -> "ReplicaStream":
+        exclude: Set[str] = set()
+        last_err: Optional[BaseException] = None
+        for _ in range(max(1, overload_retries + 1)):
+            replica = rs.assign(timeout=assign_timeout,
+                                exclude=exclude)
+            ref = _call_under_span(
+                root if sampled else None,
+                lambda: replica.handle_request_with_load.remote(
+                    "__llm_open__", (payload,), kwargs))
+            try:
+                out = ray_tpu.get(ref, timeout=open_timeout)
+            except Exception as e:
+                if is_overload_error(e):
+                    exclude.add(replica._id_hex)
+                    rs.record_report(replica._id_hex,
+                                     queue_len=float("inf"))
+                    last_err = e
+                    continue
+                raise
+            finally:
+                rs.release(replica)
+            if isinstance(out, dict) and "__serve_result__" in out:
+                load = out.get("__serve_load__")
+                if isinstance(load, dict):
+                    rs.record_report(replica._id_hex,
+                                     load.get("queue_len", 0),
+                                     load.get("ewma_s", 0.0),
+                                     load.get("ts"))
+                out = out["__serve_result__"]
+            return ReplicaStream(deployment_name, replica,
+                                 out["stream_id"], root)
+        raise last_err
+
+    def _hop(self, rs, payload, kwargs, root, role: str,
+             method: str, *, assign_timeout, open_timeout,
+             overload_retries) -> Optional[Tuple[Any, Any]]:
+        """One admission hop against the ``role`` sub-fleet: assign a
+        replica of that role, call ``method``, unwrap the load
+        envelope. Returns (replica, result) or None when the hop can't
+        complete retriably (role empty / all shed / assign timeout) —
+        the caller falls back. Non-overload errors raise."""
+        members = rs.role_members(role)
+        if not members:
+            return None
+        exclude = rs.member_ids() - members
+        for _ in range(max(1, overload_retries + 1)):
+            try:
+                replica = rs.assign(timeout=assign_timeout,
+                                    exclude=exclude)
+            except TimeoutError:
+                return None   # role sub-fleet saturated: fall back
+            ref = _call_under_span(
+                root, lambda: replica.handle_request_with_load.remote(
+                    method, (payload,), kwargs))
+            try:
+                out = ray_tpu.get(ref, timeout=open_timeout)
+            except Exception as e:
+                if is_overload_error(e):
+                    exclude.add(replica._id_hex)
+                    rs.record_report(replica._id_hex,
+                                     queue_len=float("inf"))
+                    continue
+                raise
+            finally:
+                rs.release(replica)
+            if isinstance(out, dict) and "__serve_result__" in out:
+                load = out.get("__serve_load__")
+                if isinstance(load, dict):
+                    rs.record_report(replica._id_hex,
+                                     load.get("queue_len", 0),
+                                     load.get("ewma_s", 0.0),
+                                     load.get("ts"))
+                out = out["__serve_result__"]
+            return replica, out
+        return None
+
+    def _open_disagg(self, deployment_name, rs, payload, kwargs,
+                     root, *, assign_timeout, open_timeout,
+                     overload_retries) -> Optional["ReplicaStream"]:
+        """Two-hop disaggregated admission: ``__llm_prefill__`` on a
+        prefill-role replica (prompt + first token + KV snapshot into a
+        plasmax ring slot), then ``__llm_adopt__`` on a decode-role
+        replica (rebind the shipped pages; re-prefill on a torn frame)
+        — the stream pins to the DECODE replica. Any structural
+        failure returns None and the caller falls back to the unified
+        single-hop open, which is always correct."""
+        try:
+            got = self._hop(rs, payload, kwargs, root, "prefill",
+                            "__llm_prefill__",
+                            assign_timeout=assign_timeout,
+                            open_timeout=open_timeout,
+                            overload_retries=overload_retries)
+            if got is None:
+                return None
+            _prefill_replica, handoff = got
+            got = self._hop(rs, handoff, kwargs, root, "decode",
+                            "__llm_adopt__",
+                            assign_timeout=assign_timeout,
+                            open_timeout=open_timeout,
+                            overload_retries=overload_retries)
+            if got is None:
+                return None
+            decode_replica, out = got
+            return ReplicaStream(deployment_name, decode_replica,
+                                 out["stream_id"], None)
+        except Exception as e:
+            # correctness is owned by the unified fallback; the two-hop
+            # path only ever improves latency, so any error degrades
+            logger.warning(
+                "llm disagg: two-hop admission failed for %r "
+                "(%s: %s); falling back to unified open",
+                deployment_name, type(e).__name__, e)
+            return None
 
     def stop(self):
         self._poller.stop()

@@ -95,7 +95,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                route_prefix: Optional[str] = None,
                pass_http_path: bool = False,
-               graceful_shutdown_timeout_s: Optional[float] = None):
+               graceful_shutdown_timeout_s: Optional[float] = None,
+               llm_roles: Optional[Dict[str, int]] = None):
     """@serve.deployment — mark a class/function as a deployment.
 
     ``max_queued_requests`` bounds each replica's ingress waiting room
@@ -115,7 +116,14 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
     service (rolling update, downscale, delete, node drain) may keep
     finishing in-flight requests after it is removed from the route
     table, before the controller kills it (default: env
-    ``RTPU_SERVE_GRACEFUL_SHUTDOWN_S``, else 10 s)."""
+    ``RTPU_SERVE_GRACEFUL_SHUTDOWN_S``, else 10 s).
+
+    ``llm_roles`` (LLM deployments) splits the replicas into prefill
+    and decode pools, e.g. ``{"prefill": 1, "decode": 2}``: the
+    controller assigns a role per ready replica in the route table and
+    the router runs new prompts through a prefill replica before
+    streaming from a decode replica, shipping the prompt's KV pages
+    between them (docs/LLM_SERVING.md). Unset = every replica unified."""
 
     def wrap(func_or_class):
         return Deployment(
@@ -131,6 +139,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                 "ray_actor_options": ray_actor_options,
                 "route_prefix": route_prefix,
                 "graceful_shutdown_timeout_s": graceful_shutdown_timeout_s,
+                "llm_roles": llm_roles,
                 # @serve.ingress classes (serve/ingress.py) opt into the
                 # proxy's path+method passing via class attributes
                 "pass_http_path": pass_http_path or bool(getattr(

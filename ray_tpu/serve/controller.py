@@ -85,20 +85,30 @@ def _health_timeout_s() -> float:
     return _env_float("RTPU_SERVE_HEALTH_TIMEOUT_S", 10.0)
 
 
-def _aggregate_llm(per_replica: Dict[str, Any]
+def _aggregate_llm(per_replica: Dict[str, Any],
+                   roles: Optional[Dict[str, str]] = None
                    ) -> Optional[Dict[str, Any]]:
     """Fold the per-replica ``llm`` load rows (serve/llm engine
     telemetry riding ``ReplicaActor.get_load``) into one deployment-
     level signal set: summed throughput/sequence counts, MEAN KV
     occupancy (each replica owns an equal pool). None when no replica
-    reports LLM metrics (stateless deployments stay on queue depth)."""
-    rows = [v["llm"] for v in per_replica.values()
-            if isinstance(v, dict) and isinstance(v.get("llm"), dict)]
+    reports LLM metrics (stateless deployments stay on queue depth).
+
+    ``roles`` (disaggregated deployments: replica id hex -> role) adds
+    a ``per_role`` breakdown so the autoscaler can see prefill and
+    decode pressure separately (docs/LLM_SERVING.md)."""
+    keyed = [(k, v["llm"]) for k, v in per_replica.items()
+             if isinstance(v, dict) and isinstance(v.get("llm"), dict)]
+    rows = [r for _, r in keyed]
     if not rows:
         return None
     n = len(rows)
-    return {
+    out = {
         "tokens_per_s": sum(r.get("tokens_per_s", 0.0) for r in rows),
+        "cache_hit_tokens_per_s": sum(
+            r.get("cache_hit_tokens_per_s", 0.0) for r in rows),
+        "cache_hit_tokens_total": sum(
+            r.get("cache_hit_tokens_total", 0) for r in rows),
         "kv_occupancy": sum(r.get("kv_occupancy", 0.0)
                             for r in rows) / n,
         "running": sum(r.get("running", 0) for r in rows),
@@ -115,6 +125,23 @@ def _aggregate_llm(per_replica: Dict[str, Any]
                           default=0.0),
         "replicas_reporting": n,
     }
+    if roles:
+        per_role: Dict[str, Dict[str, Any]] = {}
+        for hex_id, r in keyed:
+            role = roles.get(hex_id, "unified")
+            agg = per_role.setdefault(role, {
+                "tokens_per_s": 0.0, "running": 0, "waiting": 0,
+                "kv_occupancy": 0.0, "replicas": 0})
+            agg["tokens_per_s"] += r.get("tokens_per_s", 0.0)
+            agg["running"] += r.get("running", 0)
+            agg["waiting"] += r.get("waiting", 0)
+            agg["kv_occupancy"] += r.get("kv_occupancy", 0.0)
+            agg["replicas"] += 1
+        for agg in per_role.values():
+            if agg["replicas"]:
+                agg["kv_occupancy"] /= agg["replicas"]
+        out["per_role"] = per_role
+    return out
 
 
 class _DeploymentInfo:
@@ -841,22 +868,44 @@ class ServeController:
             changed = True
         return changed
 
+    @staticmethod
+    def _llm_roles_map(info, replica_hexes) -> Optional[Dict[str, str]]:
+        """Assign prefill/decode roles over a disaggregated LLM
+        deployment's live replicas (``llm_roles`` in the config,
+        e.g. ``{"prefill": 1, "decode": 2}``).  Assignment is by
+        replica AGE (detached actor names carry a monotonically
+        increasing #seq): the oldest ``n_prefill`` replicas prefill,
+        the rest decode.  Age-stable ordering means a rolling update
+        replaces roles one replica at a time instead of reshuffling
+        the whole fleet on every wave."""
+        roles_cfg = info.config.get("llm_roles")
+        if not roles_cfg or not replica_hexes:
+            return None
+        ordered = sorted(replica_hexes,
+                         key=lambda hx: info.replica_names.get(hx, hx))
+        n_prefill = max(0, int(roles_cfg.get("prefill", 0)))
+        out = {}
+        for i, hx in enumerate(ordered):
+            out[hx] = "prefill" if i < n_prefill else "decode"
+        return out
+
     def _publish_route_table(self, force: bool = False):
         with self._lock:
             table = {}
             for name, info in self._deployments.items():
                 if info.config.get("_deleted"):
                     continue
+                replicas = [h._id_hex
+                            for h in info.replicas
+                            if h in info.ready
+                            and h not in info.draining]
                 table[name] = {
                     # only health-confirmed replicas carry traffic: a
                     # just-started (possibly broken) replica enters the
                     # table when its first probe passes, and a draining
                     # replica is already out — removal from the table
                     # is step 1 of the drain
-                    "replicas": [h._id_hex
-                                 for h in info.replicas
-                                 if h in info.ready
-                                 and h not in info.draining],
+                    "replicas": replicas,
                     "max_concurrent_queries":
                         info.config.get("max_concurrent_queries", 100),
                     "max_queued_requests":
@@ -868,6 +917,9 @@ class ServeController:
                     "pass_http_method":
                         bool(info.config.get("pass_http_method")),
                 }
+                roles = self._llm_roles_map(info, replicas)
+                if roles is not None:
+                    table[name]["replica_roles"] = roles
             if not force and table == self._last_published_table:
                 return
             self._last_published_table = table
@@ -971,7 +1023,10 @@ class ServeController:
                 # that the policy may scale on (docs/LLM_SERVING.md).
                 decision = info.autoscaler.get_decision(
                     len(handles), total_queue, now,
-                    signals=_aggregate_llm(per_replica))
+                    signals=_aggregate_llm(
+                        per_replica,
+                        roles=self._llm_roles_map(
+                            info, list(per_replica))))
                 if decision != info.target_replicas:
                     with self._lock:
                         info.target_replicas = decision

@@ -4,7 +4,10 @@ Continuous batching + paged KV cache + end-to-end token streaming
 (docs/LLM_SERVING.md). The pieces:
 
   engine.LLMEngine         per-replica continuous-batching scheduler
-  kv_cache.PagedKVCache    block allocator (vLLM-style pages)
+  kv_cache.PagedKVCache    refcounted block allocator (vLLM-style pages)
+  prefix_cache.RadixPrefixCache  shared-prompt radix KV cache
+  spec_decode              draft models + greedy speculative verify
+  disagg.KVShipper         prefill→decode KV-page handoff (plasmax)
   model_runner             ToyAdapter / FlaxModelAdapter (gpt2, llama)
   deployment.LLMServer     the serve deployment callable
 
@@ -23,14 +26,20 @@ HTTP: POST the same payload with ``"stream": true`` (or
 """
 
 from ray_tpu.serve.llm.deployment import ByteTokenizer, LLMServer
+from ray_tpu.serve.llm.disagg import KVShipError, KVShipper
 from ray_tpu.serve.llm.engine import (EngineConfig, LLMEngine,
                                       SamplingParams)
 from ray_tpu.serve.llm.kv_cache import OutOfKVBlocksError, PagedKVCache
 from ray_tpu.serve.llm.model_runner import (FlaxModelAdapter, ToyAdapter,
                                             make_adapter)
+from ray_tpu.serve.llm.prefix_cache import RadixPrefixCache
+from ray_tpu.serve.llm.spec_decode import (FlaxDraft, ToyDraft,
+                                           greedy_verify, make_draft)
 
 __all__ = [
     "LLMServer", "LLMEngine", "EngineConfig", "SamplingParams",
     "PagedKVCache", "OutOfKVBlocksError", "ToyAdapter",
     "FlaxModelAdapter", "make_adapter", "ByteTokenizer",
+    "RadixPrefixCache", "KVShipper", "KVShipError",
+    "ToyDraft", "FlaxDraft", "greedy_verify", "make_draft",
 ]

@@ -10,6 +10,11 @@ shedding, ledgers, tracing, HA and drain all apply unchanged:
   ``__llm_next__(sid, cursor, w)`` cursor poll -> token delta
   ``__llm_cancel__(sid)``          abandon a stream
   ``__llm_metrics__()``            engine metrics + token ledger
+  ``__llm_prefill__(payload)``     disagg hop 1: prompt + first token,
+                                   returns a KV handoff descriptor
+  ``__llm_adopt__(handoff)``       disagg hop 2: rebind the shipped KV
+                                   (or re-prefill on a torn frame) ->
+                                   {"stream_id", "adopted"}
 
 Serve integration hooks (consumed by ``_private/replica.py``):
 
@@ -35,11 +40,17 @@ Payload schema (dict): ``prompt`` (str, byte-tokenized) or ``tokens``
 
 from __future__ import annotations
 
+import logging
+import os
+import time
 from typing import Any, Dict, List, Optional, Union
 
+from ray_tpu.serve.llm.disagg import KVShipError, KVShipper
 from ray_tpu.serve.llm.engine import (EngineConfig, LLMEngine,
                                       SamplingParams)
 from ray_tpu.serve.llm.model_runner import make_adapter
+
+logger = logging.getLogger(__name__)
 
 
 class ByteTokenizer:
@@ -76,6 +87,27 @@ class LLMServer:
         self.engine = LLMEngine(self.adapter, cfg)
         self.tokenizer = ByteTokenizer(self.adapter.vocab_size)
         self.model = model
+        self._shipper: Optional[KVShipper] = None
+
+    def _get_shipper(self) -> KVShipper:
+        if self._shipper is None:
+            self._shipper = KVShipper(f"{os.getpid()}-{id(self)}")
+        return self._shipper
+
+    @staticmethod
+    def _trace_ctx() -> Optional[Dict[str, str]]:
+        # parent the engine's phase spans under THIS request's replica
+        # execute span (installed by replica._execute for sampled
+        # requests) so TTFT decomposes on the trace waterfall
+        try:
+            from ray_tpu._private import worker as worker_mod
+            w = worker_mod._global_worker
+            if w is not None:
+                ctx = getattr(w.task_context, "trace", None)
+                return dict(ctx) if ctx else None
+        except Exception:
+            pass
+        return None
 
     # ------------------------------------------------------------ intake
 
@@ -96,20 +128,9 @@ class LLMServer:
     def _open(self, payload, request_id: Optional[str]) -> str:
         sampling = (SamplingParams.from_payload(payload)
                     if isinstance(payload, dict) else SamplingParams())
-        # parent the engine's phase spans under THIS request's replica
-        # execute span (installed by replica._execute for sampled
-        # requests) so TTFT decomposes on the trace waterfall
-        trace_ctx = None
-        try:
-            from ray_tpu._private import worker as worker_mod
-            w = worker_mod._global_worker
-            if w is not None:
-                trace_ctx = getattr(w.task_context, "trace", None)
-        except Exception:
-            pass
         return self.engine.add_request(
             self._tokens_of(payload), sampling, request_id=request_id,
-            trace_ctx=dict(trace_ctx) if trace_ctx else None)
+            trace_ctx=self._trace_ctx())
 
     # --------------------------------------------------------- serve API
 
@@ -153,6 +174,96 @@ class LLMServer:
     def __llm_cancel__(self, stream_id: str):
         return {"cancelled": self.engine.cancel(stream_id)}
 
+    # -------------------------------------- disaggregation (disagg.py)
+
+    def __llm_prefill__(self, payload=None, __rtpu_request_id__=None):
+        """Disagg hop 1 (prefill replica): run prompt + ONE token,
+        snapshot the prompt's KV pages, and return a handoff
+        descriptor the router carries to a decode replica.  The
+        descriptor always includes the prompt + sampling so the decode
+        side can re-prefill if the KV frame is lost."""
+        payload = payload or {}
+        sampling = (SamplingParams.from_payload(payload)
+                    if isinstance(payload, dict) else SamplingParams())
+        tokens = self._tokens_of(payload)
+        sid = self.engine.prefill_export(
+            tokens, sampling, request_id=__rtpu_request_id__,
+            trace_ctx=self._trace_ctx())
+        cursor = 0
+        while True:
+            chunk = self.engine.poll(sid, cursor, max_wait_s=30.0)
+            cursor = chunk["cursor"]
+            if chunk["done"]:
+                break
+        if chunk.get("error"):
+            raise RuntimeError(f"prefill failed: {chunk['error']}")
+        export = self.engine.take_export(sid) or {}
+        first = export.get("first_token")
+        if first is None:
+            raise RuntimeError("prefill produced no first token")
+        handoff: Dict[str, Any] = {
+            "prompt": tokens,
+            "first_token": int(first),
+            "n_prompt": len(tokens),
+            "sampling": sampling.to_payload(),
+            "t_ship_start": time.time(),
+        }
+        terminal = (sampling.max_new_tokens <= 1
+                    or (sampling.stop_token is not None
+                        and int(first) == sampling.stop_token))
+        if not terminal and export.get("kv") is not None:
+            handoff["kv"] = self._get_shipper().ship({"kv": export["kv"]})
+        return handoff
+
+    def __llm_adopt__(self, handoff=None, __rtpu_request_id__=None):
+        """Disagg hop 2 (decode replica): fetch the KV frame, rebind
+        its pages into this replica's pool, and continue decoding from
+        the prefill replica's first token.  Any transport fault —
+        chaos drop/reset, CRC mismatch, vanished ring slot, blob
+        mismatch — falls back to a local re-prefill: greedy decode is
+        deterministic, so the stream is output-identical."""
+        handoff = handoff or {}
+        rid = __rtpu_request_id__
+        trace_ctx = self._trace_ctx()
+        prompt = [int(t) for t in handoff.get("prompt") or []]
+        sampling = SamplingParams.from_payload(
+            dict(handoff.get("sampling") or {}))
+        first = handoff.get("first_token")
+        terminal = (sampling.max_new_tokens <= 1
+                    or (sampling.stop_token is not None and first is not None
+                        and int(first) == sampling.stop_token))
+        if terminal and first is not None:
+            sid = self.engine.adopt_request(
+                prompt, int(first), None, sampling, request_id=rid,
+                trace_ctx=trace_ctx)
+            return {"stream_id": sid, "adopted": True}
+        blob = None
+        desc = handoff.get("kv")
+        if desc is not None and first is not None:
+            try:
+                frame = self._get_shipper().receive(
+                    desc, method="__llm_adopt__")
+            except KVShipError:
+                frame = None
+            blob = (frame or {}).get("kv")
+        if blob is not None:
+            try:
+                sid = self.engine.adopt_request(
+                    prompt, int(first), blob, sampling, request_id=rid,
+                    trace_ctx=trace_ctx,
+                    lane=desc.get("lane", "inline"),
+                    t_ship_start=handoff.get("t_ship_start"))
+                return {"stream_id": sid, "adopted": True}
+            except Exception:
+                logger.warning(
+                    "llm.kv_ship: adoption failed, re-prefilling",
+                    exc_info=True)
+        # fallback: deterministic re-prefill on this (decode) replica;
+        # sheds retriably if this replica is saturated
+        sid = self.engine.add_request(prompt, sampling, request_id=rid,
+                                      trace_ctx=trace_ctx)
+        return {"stream_id": sid, "adopted": False}
+
     def __llm_metrics__(self):
         m = self.engine.metrics()
         m["token_ledger"] = self.engine.token_ledger()
@@ -182,6 +293,11 @@ class LLMServer:
             ledger = self.engine.token_ledger()
             if ledger:
                 store.flush_llm_ledger(replica_name, ledger)
+        except Exception:
+            pass
+        try:
+            if self._shipper is not None:
+                self._shipper.free()
         except Exception:
             pass
         try:

@@ -1,14 +1,25 @@
 """Model adapters: the engine's prefill/decode contract.
 
 An adapter owns the *storage* of the paged KV pool (the engine's
-``PagedKVCache`` owns only the allocator) and exposes exactly two
-compute entry points:
+``PagedKVCache`` owns only the allocator) and exposes the compute
+entry points:
 
     prefill(seqs) -> logits [B, V]   write the prompts' KV into their
-                                     pages, return last-token logits
+                                     pages (skipping any cached-prefix
+                                     tokens), return last-token logits
     decode(seqs)  -> logits [B, V]   append each sequence's newest
                                      sampled token, attend against the
                                      cached prefix, return next logits
+    decode_window(seqs, windows)     speculative decode: append a
+                                     window of tokens per sequence in
+                                     ONE batched step and return the
+                                     logits after every position
+    rollback(seq_id, n)              retract the last n cached tokens
+                                     (rejected speculative positions)
+    copy_page(src, dst)              duplicate page contents (copy-on-
+                                     write of a shared prefix page)
+    export_kv / import_kv            serialize / rebind a prompt's KV
+                                     pages for prefill→decode handoff
 
 Two implementations:
 
@@ -25,6 +36,10 @@ Two implementations:
   rows parked on the null page. On TPU the single-token decode rides
   the ``paged_attention_decode`` Pallas kernel via the shared cached
   paths; on CPU the gather reference keeps numerics identical.
+  ``decode_window`` reuses the same paged path — the multi-token
+  incremental step is causal at the right offsets by construction
+  (``q_positions = seq_lengths[:, None] + arange(S)``), so batched
+  speculative verification is numerically the plain decode loop.
 """
 
 from __future__ import annotations
@@ -41,6 +56,10 @@ def _pad_pow2(n: int, lo: int = 1) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _cached_tokens(seq) -> int:
+    return int(getattr(seq, "cached_tokens", 0) or 0)
 
 
 class ToyAdapter:
@@ -71,6 +90,9 @@ class ToyAdapter:
         # seq id -> {"table": np.ndarray pages, "len": cached tokens}
         self._state: Dict[str, Dict[str, Any]] = {}
 
+    def copy_page(self, src: int, dst: int):
+        self.pages[dst] = self.pages[src]
+
     def _write(self, st, tokens: List[int]):
         bs = self.cache.block_size
         table = st["table"]
@@ -88,19 +110,33 @@ class ToyAdapter:
         h = flat.mean(axis=0)
         return (h @ self.embed.T).astype(np.float32)
 
+    def _cow_partial_page(self, seq_id: str, st, cached: int):
+        """A cached prefix ending mid-page means our first write lands
+        in a shared page: take a private copy first (copy-on-extend)."""
+        bs = self.cache.block_size
+        if cached % bs == 0:
+            return
+        old, new = self.cache.copy_on_write(seq_id, cached // bs)
+        if new != old:
+            self.copy_page(old, new)
+            st["table"] = np.asarray(
+                self.cache.block_table(seq_id), np.int64)
+
     def prefill(self, seqs) -> np.ndarray:
-        n_tok = sum(len(s.prompt) for s in seqs)
+        n_tok = sum(len(s.prompt) - _cached_tokens(s) for s in seqs)
         if self.step_delay_s or self.per_prefill_token_delay_s:
             time.sleep(self.step_delay_s
                        + self.per_prefill_token_delay_s * n_tok)
         out = np.zeros((len(seqs), self.vocab_size), np.float32)
         with self._lock:
             for i, s in enumerate(seqs):
+                cached = _cached_tokens(s)
                 st = {"table": np.asarray(
                     self.cache.block_table(s.seq_id), np.int64),
-                    "len": 0}
+                    "len": cached}
                 self._state[s.seq_id] = st
-                self._write(st, s.prompt)
+                self._cow_partial_page(s.seq_id, st, cached)
+                self._write(st, s.prompt[cached:])
                 out[i] = self._logits(st)
         return out
 
@@ -115,6 +151,55 @@ class ToyAdapter:
                 self._write(st, [s.tokens[-1]])
                 out[i] = self._logits(st)
         return out
+
+    def decode_window(self, seqs, windows) -> List[np.ndarray]:
+        """Append each sequence's token window, returning logits after
+        EVERY window position ([w_i, V] per sequence). The toy model is
+        sequential anyway; the contract (and the flax implementation)
+        is one batched step."""
+        if self.step_delay_s or self.per_seq_delay_s:
+            time.sleep(self.step_delay_s
+                       + self.per_seq_delay_s * len(seqs))
+        out = []
+        with self._lock:
+            for s, win in zip(seqs, windows):
+                st = self._state[s.seq_id]
+                rows = np.zeros((len(win), self.vocab_size), np.float32)
+                for j, tok in enumerate(win):
+                    self._write(st, [int(tok)])
+                    rows[j] = self._logits(st)
+                out.append(rows)
+        return out
+
+    def rollback(self, seq_id: str, n: int):
+        with self._lock:
+            st = self._state.get(seq_id)
+            if st is not None and n > 0:
+                st["len"] = max(0, st["len"] - int(n))
+
+    def export_kv(self, seq_id: str, n_prompt: int) -> Dict[str, Any]:
+        """Snapshot the prompt's KV pages for prefill→decode handoff."""
+        bs = self.cache.block_size
+        nb = -(-int(n_prompt) // bs)
+        with self._lock:
+            st = self._state[seq_id]
+            table = np.asarray(st["table"][:nb], np.int64)
+            return {"kind": "toy", "n": int(n_prompt),
+                    "pages": self.pages[table].copy()}
+
+    def import_kv(self, seq_id: str, n_prompt: int,
+                  blob: Dict[str, Any]):
+        """Rebind shipped prompt KV into this replica's (freshly
+        allocated, private) pages."""
+        if blob.get("kind") != "toy":
+            raise ValueError("KV blob is not from a toy adapter")
+        bs = self.cache.block_size
+        nb = -(-int(n_prompt) // bs)
+        with self._lock:
+            table = np.asarray(
+                self.cache.block_table(seq_id), np.int64)
+            self.pages[table[:nb]] = blob["pages"]
+            self._state[seq_id] = {"table": table, "len": int(n_prompt)}
 
     def release(self, seq_id: str):
         with self._lock:
@@ -158,7 +243,7 @@ class FlaxModelAdapter:
             dummy = jnp.zeros((1, 8), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), dummy)
         self.params = params
-        self._fns: Dict[Any, Any] = {}     # (B, S, NB) -> jitted step
+        self._fns: Dict[Any, Any] = {}     # (B, S, full?) -> jitted step
         self._lock = threading.Lock()
 
     @property
@@ -181,8 +266,15 @@ class FlaxModelAdapter:
                     getattr(self.cfg, "max_seq_len", 2048)))
         self._state: Dict[str, Dict[str, Any]] = {}
 
-    def _step_fn(self, B: int, S: int):
-        key = (B, S)
+    def copy_page(self, src: int, dst: int):
+        with self._lock:
+            self.k_pages = self.k_pages.at[:, dst].set(
+                self.k_pages[:, src])
+            self.v_pages = self.v_pages.at[:, dst].set(
+                self.v_pages[:, src])
+
+    def _step_fn(self, B: int, S: int, full: bool = False):
+        key = (B, S, full)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
@@ -200,6 +292,9 @@ class FlaxModelAdapter:
                 seq_lengths=seq_lengths, valid=valid)
             k_new = jnp.stack([c["k_pages"] for c in new])
             v_new = jnp.stack([c["v_pages"] for c in new])
+            if full:
+                # speculative verify reads logits at EVERY position
+                return logits, k_new, v_new
             # last REAL token's logits per row
             idx = jnp.maximum(
                 jnp.sum(valid.astype(jnp.int32), axis=1) - 1, 0)
@@ -214,9 +309,11 @@ class FlaxModelAdapter:
         self._fns[key] = fn
         return fn
 
-    def _run(self, rows: List[Dict[str, Any]]) -> np.ndarray:
+    def _run(self, rows: List[Dict[str, Any]],
+             full: bool = False) -> np.ndarray:
         """rows: [{tokens: [ints], len: cache length, table: [pages]}]
-        -> last-token logits for the real rows."""
+        -> last-token logits [B, V] (or full [B, S, V] when ``full``)
+        for the real rows."""
         jnp = self._jnp
         B = _pad_pow2(len(rows))
         S = _pad_pow2(max(len(r["tokens"]) for r in rows), 8)
@@ -231,7 +328,7 @@ class FlaxModelAdapter:
             valid[i, :n] = True
             t = r["table"][:self.nb_max]
             tables[i, :len(t)] = t
-        fn = self._step_fn(B, S)
+        fn = self._step_fn(B, S, full)
         with self._lock:
             logits, self.k_pages, self.v_pages = fn(
                 self.params, jnp.asarray(tokens), self.k_pages,
@@ -242,10 +339,19 @@ class FlaxModelAdapter:
     def prefill(self, seqs) -> np.ndarray:
         rows = []
         for s in seqs:
+            cached = _cached_tokens(s)
+            if cached % self.cache.block_size:
+                # copy-on-extend: the suffix write lands in the last
+                # shared prefix page — privatize it first
+                old, new = self.cache.copy_on_write(
+                    s.seq_id, cached // self.cache.block_size)
+                if new != old:
+                    self.copy_page(old, new)
             table = self.cache.block_table(s.seq_id)
             self._state[s.seq_id] = {"table": table,
                                      "len": len(s.prompt)}
-            rows.append({"tokens": s.prompt, "len": 0, "table": table})
+            rows.append({"tokens": s.prompt[cached:], "len": cached,
+                         "table": table})
         return self._run(rows)
 
     def decode(self, seqs) -> np.ndarray:
@@ -256,6 +362,55 @@ class FlaxModelAdapter:
                          "table": st["table"]})
             st["len"] += 1
         return self._run(rows)
+
+    def decode_window(self, seqs, windows) -> List[np.ndarray]:
+        """One batched multi-token incremental step; causal masking at
+        the right offsets comes from ``cached_attention``'s
+        ``q_positions``, so position j's logits condition on exactly
+        window[:j+1] — the speculative verify contract."""
+        rows = []
+        for s, win in zip(seqs, windows):
+            st = self._state[s.seq_id]
+            rows.append({"tokens": list(win), "len": st["len"],
+                         "table": st["table"]})
+            st["len"] += len(win)
+        full = self._run(rows, full=True)      # [B, S, V]
+        return [full[i, :len(win)] for i, win in enumerate(windows)]
+
+    def rollback(self, seq_id: str, n: int):
+        st = self._state.get(seq_id)
+        if st is not None and n > 0:
+            st["len"] = max(0, st["len"] - int(n))
+
+    def export_kv(self, seq_id: str, n_prompt: int) -> Dict[str, Any]:
+        jnp = self._jnp
+        bs = self.cache.block_size
+        nb = -(-int(n_prompt) // bs)
+        st = self._state[seq_id]
+        idx = jnp.asarray(np.asarray(st["table"][:nb], np.int32))
+        with self._lock:
+            k = np.asarray(self.k_pages[:, idx])
+            v = np.asarray(self.v_pages[:, idx])
+        return {"kind": f"flax:{self.kind}", "n": int(n_prompt),
+                "k": k, "v": v}
+
+    def import_kv(self, seq_id: str, n_prompt: int,
+                  blob: Dict[str, Any]):
+        jnp = self._jnp
+        if blob.get("kind") != f"flax:{self.kind}":
+            raise ValueError(
+                f"KV blob kind {blob.get('kind')!r} does not match "
+                f"adapter flax:{self.kind}")
+        bs = self.cache.block_size
+        nb = -(-int(n_prompt) // bs)
+        table = self.cache.block_table(seq_id)
+        idx = jnp.asarray(np.asarray(table[:nb], np.int32))
+        with self._lock:
+            self.k_pages = self.k_pages.at[:, idx].set(
+                jnp.asarray(blob["k"], self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[:, idx].set(
+                jnp.asarray(blob["v"], self.v_pages.dtype))
+        self._state[seq_id] = {"table": table, "len": int(n_prompt)}
 
     def release(self, seq_id: str):
         self._state.pop(seq_id, None)

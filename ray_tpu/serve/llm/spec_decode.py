@@ -1,0 +1,159 @@
+"""Speculative decoding: a small draft proposes, the target verifies.
+
+Greedy speculative decoding turns k sequential target decode steps
+into one batched step without changing a single output token:
+
+1. a cheap *draft* model proposes ``k`` greedy continuations,
+2. the target runs ONE multi-token incremental step over
+   ``[t_last, p_1 .. p_k]`` through the paged decode path
+   (``adapter.decode_window``) — position ``j``'s logits condition on
+   exactly the window prefix, because ``cached_attention`` is causal
+   at the offset,
+3. ``greedy_verify`` walks the target's argmaxes: a proposal is
+   accepted while it equals what greedy decode *would* have emitted;
+   the first mismatch is replaced by the target's own token
+   (correction), and a fully accepted window yields one extra target
+   token for free (bonus).
+
+Acceptance therefore commits exactly the token sequence sequential
+greedy decode produces — token-for-token identity is a theorem, not a
+tuning goal; the tests in tests/test_llm_fleet.py assert it for the
+toy model, gpt2, and llama (with a gpt2 draft — both tiny configs
+share a 512-token vocab).
+
+Drafts are *stateless* (no paged cache): the toy draft replays the
+toy adapter's closed-form logits; the flax draft runs a full
+non-incremental forward per proposed token, which is the right
+trade for tiny draft models and keeps the KV pool untouched by
+speculation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def greedy_verify(window: Sequence[int],
+                  argmax_tokens: Sequence[int]) -> List[int]:
+    """Accept/reject a speculative window.
+
+    ``window`` is ``[t_last, p_1 .. p_{w-1}]`` (last committed token
+    followed by draft proposals); ``argmax_tokens[j]`` is the target's
+    greedy token after consuming ``window[:j+1]``.  Returns the tokens
+    to commit: the accepted proposals, then either the target's
+    correction at the first mismatch or — if every proposal matched —
+    the bonus token after the full window.
+    """
+    committed: List[int] = []
+    for j in range(len(window)):
+        t = int(argmax_tokens[j])
+        committed.append(t)
+        if j + 1 < len(window) and int(window[j + 1]) != t:
+            break
+    return committed
+
+
+class ToyDraft:
+    """Greedy draft mirroring ``ToyAdapter``'s closed-form LM (next
+    token = argmax(mean(embed[prefix]) @ E^T)) — cache-free, so it can
+    draft for any toy target; seed it like the target for a
+    high-acceptance draft or differently for an adversarial one."""
+
+    def __init__(self, vocab_size: int = 256, dim: int = 32,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.vocab_size = int(vocab_size)
+        self.embed = rng.randn(self.vocab_size, int(dim)).astype(
+            np.float32)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        toks = [int(t) % self.vocab_size for t in tokens]
+        out: List[int] = []
+        acc = self.embed[toks].sum(axis=0)
+        for _ in range(int(k)):
+            h = acc / len(toks)
+            t = int(np.argmax(h @ self.embed.T))
+            out.append(t)
+            toks.append(t)
+            acc = acc + self.embed[t]
+        return out
+
+
+class FlaxDraft:
+    """Greedy draft over a (tiny) gpt2/llama checkpoint: one full
+    non-incremental forward per proposed token, jitted per padded
+    length bucket.  No paged cache — speculation never touches the
+    target's KV pool."""
+
+    def __init__(self, kind: str = "gpt2", config=None,
+                 params=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.kind = kind
+        if kind == "gpt2":
+            from ray_tpu.models import gpt2
+            self.cfg = config or gpt2.GPT2Config.tiny()
+            self.model = gpt2.GPT2(self.cfg)
+        elif kind == "llama":
+            from ray_tpu.models import llama
+            self.cfg = config or llama.LlamaConfig.tiny()
+            self.model = llama.LlamaModel(self.cfg)
+        else:
+            raise ValueError(f"unknown draft kind {kind!r}")
+        self.vocab_size = self.cfg.vocab_size
+        if params is None:
+            dummy = jnp.zeros((1, 8), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        self.params = params
+        self._fns: Dict[int, Any] = {}
+
+    def _fn(self, S: int):
+        fn = self._fns.get(S)
+        if fn is not None:
+            return fn
+        import jax
+
+        def fwd(params, tokens):
+            return self.model.apply(params, tokens)
+
+        fn = jax.jit(fwd)
+        self._fns[S] = fn
+        return fn
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        jnp = self._jnp
+        toks = [int(t) for t in tokens]
+        out: List[int] = []
+        max_pos = getattr(self.cfg, "n_positions",
+                          getattr(self.cfg, "max_seq_len", 2048))
+        for _ in range(int(k)):
+            n = len(toks)
+            if n >= max_pos:
+                break
+            S = 8
+            while S < n:
+                S *= 2
+            S = min(S, max_pos)
+            padded = np.zeros((1, S), np.int32)
+            padded[0, :n] = toks
+            # causal attention: positions < n never see the padding
+            logits = self._fn(S)(self.params, jnp.asarray(padded))
+            t = int(np.argmax(np.asarray(logits[0, n - 1])))
+            out.append(t)
+            toks.append(t)
+        return out
+
+
+def make_draft(model: str = "toy",
+               model_config: Optional[Dict[str, Any]] = None):
+    """Engine-facing factory mirroring ``make_adapter``: ``model`` is
+    ``toy`` | ``gpt2`` | ``llama``."""
+    model_config = dict(model_config or {})
+    if model == "toy":
+        return ToyDraft(**model_config)
+    if model in ("gpt2", "llama"):
+        return FlaxDraft(kind=model, **model_config)
+    raise ValueError(f"unknown draft model {model!r} (toy|gpt2|llama)")
