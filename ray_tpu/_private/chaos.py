@@ -32,53 +32,12 @@ integer seed, or JSON::
 
 Spec filters: ``proc`` (role: driver/worker/raylet/gcs), ``head``
 (raylet head-ness), ``method`` (the site's method/context string).
-Sites wired through the runtime:
-
-    protocol.send / protocol.recv   drop | delay | dup | reset
-                                    (BOTH implementations of the wire:
-                                    the asyncio Connection loops in
-                                    protocol.py AND the native frame
-                                    pump's direct-execution lane in
-                                    direct.py hit these sites at the
-                                    frame boundary with identical
-                                    semantics, so one seeded schedule
-                                    replays against either —
-                                    docs/WIRE_PROTOCOL.md
-                                    "Implementations")
-    rpc.request                     kill (server-side, any process)
-    worker.execute                  kill (the executing worker, SIGKILL)
-    raylet.dispatch                 kill_worker | kill | preempt
-    object.pull                     evict | corrupt
-    serve.controller.tick           kill (SIGKILL the serve controller at
-                                    the N-th control-loop tick; the GCS
-                                    restarts it and it recovers from the
-                                    journal — docs/SERVE_HA.md)
-    serve.replica.request           kill (SIGKILL one serve replica at
-                                    the N-th accepted request; method
-                                    filter = deployment name)
-    dag.channel                     kill | reset | drop | delay
-                                    (compiled-DAG channel frames,
-                                    ray_tpu/dag/channel.py: ``kill``
-                                    SIGKILLs the stage worker mid-graph,
-                                    ``reset`` severs the peer channel,
-                                    ``drop``/``delay`` lose/stall one
-                                    frame; method filter = frame method,
-                                    dag_exec / dag_result)
-    dag.stage                       kill (SIGKILL the worker hosting one
-                                    specific compiled-DAG stage at its
-                                    N-th execution; method filter = the
-                                    stage id as a string)
-    llm.kv_ship                     drop | delay | reset | corrupt
-                                    (disaggregated LLM serving's
-                                    prefill→decode KV handoff,
-                                    serve/llm/disagg.py: fires on the
-                                    receive side mid-handoff; ``drop``
-                                    loses the frame, ``corrupt`` flips a
-                                    byte so the CRC rejects it, ``reset``
-                                    raises KVShipError — every op
-                                    degrades to a decode-side re-prefill
-                                    with no leaked KV pages; method
-                                    filter = __llm_adopt__)
+The sites wired through the runtime are *declared* in :data:`SITES`
+below — the one authoritative table (rtpulint RTPU004 rejects any
+``chaos.hit(...)`` whose site isn't in it, the registry round-trip in
+``tests/test_static_analysis.py`` requires every declared site to be
+exercised by the test tree, and ``python -m ray_tpu.analysis
+--gen-docs`` renders it into docs/FAULT_TOLERANCE.md).
 
 Every fired fault is appended to the chaos log (``RTPU_CHAOS_LOG`` path;
 JSONL of ``{n, site, op, method, seq, ts}`` — everything except ``ts``
@@ -103,6 +62,89 @@ from typing import Any, Callable, Dict, List, Optional
 # ops the engine executes itself (process-generic); everything else is
 # returned to the caller, which owns the op's semantics at that site
 _SELF_KILL_OPS = ("kill",)
+
+# The declared injection-site registry: site -> {"ops": [...],
+# "where": one-line description of the code path that calls
+# ``chaos.hit(site)`` and what each op does there}. Adding a
+# ``chaos.hit`` call REQUIRES a row here (rtpulint RTPU004), and every
+# row must be exercised by tests/ (the RTPU004 round-trip) — an
+# undeclared site is a typo that silently never fires; an unexercised
+# one is a fault path that ships untested. docs/FAULT_TOLERANCE.md's
+# site table is rendered from this dict, never hand-edited.
+SITES: Dict[str, Dict[str, Any]] = {
+    "protocol.send": {
+        "ops": ["drop", "delay", "dup", "reset"],
+        "where": ("every framed message, BOTH wire implementations — "
+                  "the asyncio `Connection` loops (protocol.py) and "
+                  "the native frame pump's direct-execution lane "
+                  "(direct.py) hit the site at the frame boundary with "
+                  "identical semantics, so one seeded schedule replays "
+                  "against either (`method` filter available)"),
+    },
+    "protocol.recv": {
+        "ops": ["drop", "delay", "dup", "reset"],
+        "where": ("receive side of the same frame boundary, both wire "
+                  "implementations (`method` filter available)"),
+    },
+    "rpc.request": {
+        "ops": ["kill"],
+        "where": ("every served request, any process — SIGKILL self "
+                  "before the handler runs"),
+    },
+    "worker.execute": {
+        "ops": ["kill"],
+        "where": ("the N-th task a worker starts executing (`method` "
+                  "filter = function name)"),
+    },
+    "raylet.dispatch": {
+        "ops": ["kill_worker", "kill", "preempt"],
+        "where": ("the N-th task a raylet dispatches: `kill_worker` "
+                  "SIGKILLs the target worker, `kill` the raylet "
+                  "itself, `preempt` starts a graceful drain "
+                  "(`grace_s`)"),
+    },
+    "object.pull": {
+        "ops": ["evict", "corrupt"],
+        "where": ("a pull about to be served: `evict` drops the "
+                  "primary copy + directory entry, `corrupt` flips "
+                  "bytes (caught by the pull crc)"),
+    },
+    "serve.controller.tick": {
+        "ops": ["kill"],
+        "where": ("the N-th serve control-loop tick — SIGKILL the "
+                  "controller; the GCS restarts it and it recovers "
+                  "from the journal (docs/SERVE_HA.md)"),
+    },
+    "serve.replica.request": {
+        "ops": ["kill"],
+        "where": ("the N-th request a serve replica accepts (`method` "
+                  "filter = deployment name)"),
+    },
+    "dag.channel": {
+        "ops": ["kill", "reset", "drop", "delay"],
+        "where": ("compiled-DAG channel frames (dag/channel.py): "
+                  "`kill` SIGKILLs the stage worker mid-graph, "
+                  "`reset` severs the peer channel, `drop`/`delay` "
+                  "lose/stall one frame (`method` filter = frame "
+                  "method, dag_exec / dag_result)"),
+    },
+    "dag.stage": {
+        "ops": ["kill"],
+        "where": ("the worker hosting one specific compiled-DAG stage "
+                  "at its N-th execution (`method` filter = the stage "
+                  "id as a string)"),
+    },
+    "llm.kv_ship": {
+        "ops": ["drop", "delay", "reset", "corrupt"],
+        "where": ("disaggregated LLM serving's prefill→decode KV "
+                  "handoff (serve/llm/disagg.py), receive side "
+                  "mid-handoff: `drop` loses the frame, `corrupt` "
+                  "flips a byte so the CRC rejects it, `reset` raises "
+                  "KVShipError — every op degrades to a decode-side "
+                  "re-prefill with no leaked KV pages (`method` "
+                  "filter = __llm_adopt__)"),
+    },
+}
 
 
 class FaultSpec:

@@ -1666,7 +1666,11 @@ class GcsServer:
                                              {"pg_id": pg_id,
                                               "bundle_index": idx})
                     except Exception:
-                        pass
+                        logger.debug(
+                            "pg %s: cancel_bundle %d on %s failed "
+                            "(node dying? resources refund on its "
+                            "death path)", pg_id, idx, nid,
+                            exc_info=True)
             return False
         # phase 2: commit; record the reservations in the ephemeral view
         # so concurrent placements see them before the next node report
@@ -1677,7 +1681,11 @@ class GcsServer:
                 await self.nodes[nid].conn.call(
                     "commit_bundle", {"pg_id": pg_id, "bundle_index": idx})
             except Exception:
-                pass
+                logger.warning(
+                    "pg %s: commit_bundle %d on %s failed after a "
+                    "successful prepare; bundle rides on the prepare "
+                    "reservation until the node report reconciles",
+                    pg_id, idx, nid, exc_info=True)
         return True
 
     async def remove_placement_group(self, payload, conn):
@@ -1693,7 +1701,10 @@ class GcsServer:
                         await node.conn.call("return_bundle", {
                             "pg_id": pg["pg_id"], "bundle_index": idx})
                     except Exception:
-                        pass
+                        logger.debug(
+                            "pg %s: return_bundle %d on %s failed "
+                            "(node death refunds it)", pg["pg_id"],
+                            idx, nid, exc_info=True)
         return {}
 
     async def get_placement_group(self, payload, conn):

@@ -1314,7 +1314,9 @@ class Raylet:
                         "deadline_unix": self._drain_deadline_unix,
                         "grace_s": grace_s})
                 except Exception:
-                    pass
+                    logger.debug("drain: preemption_notice to worker "
+                                 "%s failed (already gone?)",
+                                 h.worker_id, exc_info=True)
         # 4. queued (undispatched) tasks can't run here any more: move
         # them to peers, or fail them retryably so the owner resubmits
         for pt in list(self.led.pending_tasks()):
@@ -2001,12 +2003,16 @@ class Raylet:
                 try:
                     self.spill_storage.delete(ent[0])
                 except Exception:
-                    pass
+                    logger.debug("free: spill delete of %s failed "
+                                 "(orphan file reaped by GC sweep)",
+                                 hex_id, exc_info=True)
             try:
                 await self.gcs.call("remove_object_location", {
                     "object_id": hex_id, "node_id": self.node_id})
             except Exception:
-                pass
+                logger.debug("free: remove_object_location %s failed; "
+                             "the location table self-heals on next "
+                             "report", hex_id, exc_info=True)
         return {}
 
     # ------------------------------------------------------------- spilling
@@ -2362,6 +2368,9 @@ class Raylet:
                                         "lines": lines[start:start + 200]},
                         })
                     except Exception:
+                        logger.debug("log monitor: publish failed; "
+                                     "retrying worker %s on next scan",
+                                     worker_id, exc_info=True)
                         break
             for path in gone:
                 tracked.pop(path, None)
@@ -2454,8 +2463,8 @@ class Raylet:
             self._oom_killed_workers.add(victim.worker_id)
             try:
                 victim.proc.kill()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already exiting; the death path still runs
             # let the death path run before re-evaluating
             await asyncio.sleep(period)
 
@@ -2492,7 +2501,9 @@ class Raylet:
                     try:
                         conn.close()
                     except Exception:
-                        pass
+                        logger.debug("view delta: closing peer conn "
+                                     "to dead node %s raised",
+                                     ent["node_id"], exc_info=True)
 
     def report_soon(self):
         """Event-driven report push (debounced): resource releases reach
@@ -2583,8 +2594,8 @@ class Raylet:
         for h in self.workers.values():
             try:
                 h.proc.kill()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already dead
         self.server.close()
         self.store.unlink()
         try:

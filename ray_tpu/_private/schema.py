@@ -66,6 +66,56 @@ from typing import Any, Dict, List, Optional, Tuple
 #      docs/WIRE_PROTOCOL.md "Implementations".
 PROTOCOL_VERSION = (1, 7)
 
+# Methods introduced after 1.0 (method -> first schema minor carrying
+# it). Callers gate on the peer's negotiated minor from ``__hello__``
+# before sending these to a long-lived connection; an unknown method
+# on an old peer is an RpcError mid-flight instead of a clean
+# downgrade. Kept next to SCHEMAS so a new method can't land without a
+# version row (the conformance vectors iterate this).
+METHOD_VERSIONS: Dict[str, Tuple[int, int]] = {
+    "lease_worker": (1, 1), "release_lease": (1, 1),
+    "revoke_lease": (1, 1), "leased_task": (1, 1),
+    "task_dispatch_status_batch": (1, 1), "task_stats": (1, 1),
+    "profile_worker": (1, 1), "profile_workers": (1, 1),
+    "preempt": (1, 2), "preempt_node": (1, 2),
+    "node_draining": (1, 2), "node_drained": (1, 2),
+    "preemption_notice": (1, 2),
+    "kv_get_prefix": (1, 3),
+    "task_events": (1, 4), "list_tasks": (1, 4),
+    "list_objects": (1, 4), "summarize": (1, 4),
+    "summarize_tasks": (1, 4), "configure_state": (1, 4),
+    "dag_channel_open": (1, 5), "dag_channel_close": (1, 5),
+    "dag_register": (1, 5), "dag_unregister": (1, 5),
+    "dag_stage_error": (1, 5), "dag_peer_down": (1, 5),
+    "dag_exec": (1, 5), "dag_result": (1, 5),
+    "trace_spans": (1, 6), "get_trace": (1, 6), "list_traces": (1, 6),
+}
+
+# Fields added to PRE-EXISTING methods after 1.0 — the compat-critical
+# map: a peer that negotiated an older minor never sends these, so
+# reading one takes either an absence-tolerant ``.get()`` or a
+# negotiated-version guard (rtpulint RTPU006 enforces exactly this,
+# keyed off this table). (method, field) -> minor introduced. Fields
+# born with their method (METHOD_VERSIONS above) need no row — method
+# existence already gates them.
+FIELD_VERSIONS: Dict[Tuple[str, str], Tuple[int, int]] = {
+    # 1.2: revoke-drain ack + per-chunk pull integrity (reply side)
+    ("release_lease", "inflight"): (1, 2),
+    ("pull_object", "crc"): (1, 2),
+    # 1.3: drain deadlines surfaced in node-table reads (reply side)
+    ("get_nodes", "drain_deadline_unix"): (1, 3),
+    # 1.6: trace contexts ride task/actor/channel frames
+    ("submit_task", "trace_ctx"): (1, 6),
+    ("actor_call", "trace_ctx"): (1, 6),
+    ("dag_exec", "tc"): (1, 6),
+    ("dag_result", "tc"): (1, 6),
+    ("configure_state", "trace_table_max"): (1, 6),
+    # 1.7: the native direct-call lane address (worker_register
+    # request + lease_worker reply)
+    ("worker_register", "direct_address"): (1, 7),
+    ("lease_worker", "direct_address"): (1, 7),
+}
+
 _str = str
 _num = numbers.Number
 _int = numbers.Integral

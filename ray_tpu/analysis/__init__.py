@@ -1,0 +1,24 @@
+"""rtpulint — project-aware static analysis for ray_tpu.
+
+Enforces the concurrency, resource and wire-protocol invariants the
+substrate's bug history keeps re-teaching (blocking calls on event
+loops, locks across ``await``, unpaired incref/decref and daemon
+threads, undeclared chaos sites, unregistered ``RTPU_*`` knobs,
+unguarded version-gated wire fields, silent swallows in control
+loops). Runs in tier-1 over the whole tree; see
+docs/STATIC_ANALYSIS.md.
+
+    ray-tpu lint [--json] [paths...]
+    python -m ray_tpu.analysis --list-checkers
+
+Public surface: :func:`analyze_paths` / :func:`analyze_source` for
+programmatic runs, :class:`Finding`, :func:`registry`, and the
+:mod:`~ray_tpu.analysis.baseline` helpers.
+"""
+
+from ray_tpu.analysis.core import (Checker, Finding, ModuleContext,
+                                   analyze_file, analyze_paths,
+                                   analyze_source, register, registry)
+
+__all__ = ["Checker", "Finding", "ModuleContext", "analyze_file",
+           "analyze_paths", "analyze_source", "register", "registry"]

@@ -1,0 +1,7 @@
+"""``python -m ray_tpu.analysis`` — same surface as ``ray-tpu lint``."""
+
+import sys
+
+from ray_tpu.analysis.cli import main
+
+sys.exit(main())

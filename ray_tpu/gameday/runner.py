@@ -141,7 +141,9 @@ def _retry(fn, timeout: float = 30.0, default=None):
             out = fn()
             if out is not None:
                 return out
-        except Exception:
+        # errors are expected while the controller restarts; the
+        # deadline below is the real failure signal
+        except Exception:  # rtpulint: ignore[RTPU007]
             pass
         if time.time() >= deadline:
             return default
@@ -167,7 +169,9 @@ def _live_replica_handles() -> Dict[str, Any]:
             try:
                 handles[hex_id] = get_actor_by_id(hex_id)
             except Exception:
-                pass
+                logger.debug("gameday: replica %s in route table but "
+                             "unresolvable (torn down mid-sweep?)",
+                             hex_id, exc_info=True)
     return handles
 
 
@@ -193,7 +197,9 @@ def _all_alive_replica_handles() -> Dict[str, Any]:
                     h._worker_address = a["worker_address"]
                 handles[a["actor_id"]] = h
             except Exception:
-                pass
+                logger.debug("gameday: could not build handle for "
+                             "replica %s", a.get("actor_id"),
+                             exc_info=True)
     except Exception:
         logger.warning("gameday: alive-replica sweep failed",
                        exc_info=True)
@@ -558,6 +564,9 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                     st = ray_tpu.get(handle.get_llm_state.remote(),
                                      timeout=10.0)
                 except Exception:
+                    logger.debug("gameday: get_llm_state from replica "
+                                 "%s failed (drained mid-grade?)",
+                                 hex_id, exc_info=True)
                     continue
                 if st:
                     llm_metrics[hex_id] = {
@@ -615,6 +624,9 @@ def run_scenario(scenario: Scenario, *, scale: float = 1.0,
                 try:
                     doc = state_api.get_trace(rid)
                 except Exception:
+                    logger.debug("gameday: trace fetch for %s failed",
+                                 rid, exc_info=True)
+                    traces_lossy = True
                     continue
                 if doc.get("dropped_spans"):
                     traces_lossy = True

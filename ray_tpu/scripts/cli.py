@@ -655,6 +655,20 @@ def main(argv=None):
     sp = gsub.add_parser("list", help="list builtin scenarios")
     sp.set_defaults(func=cmd_gameday_list)
 
+    # `ray-tpu lint ...` delegates argv wholesale to the rtpulint CLI
+    # (ray_tpu/analysis/cli.py) so `ray-tpu lint` and `python -m
+    # ray_tpu.analysis` stay one surface — docs/STATIC_ANALYSIS.md
+    sp = sub.add_parser(
+        "lint", add_help=False,
+        help="project-aware static analysis (rtpulint; see "
+             "docs/STATIC_ANALYSIS.md)")
+    sp.set_defaults(func=None)
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        from ray_tpu.analysis.cli import main as lint_main
+        sys.exit(lint_main(argv[1:]))
+
     args = p.parse_args(argv)
     args.func(args)
 

@@ -413,6 +413,16 @@ class ReplicaActor:
                     self.replica_name)
             except Exception:
                 pass
+        # stop @serve.batch flusher threads: admission is closed and
+        # in-flight requests finished, so the queues are drained
+        if not self._is_function:
+            d = getattr(self.callable, "__dict__", None) or {}
+            for attr, v in list(d.items()):
+                if attr.startswith("__serve_batcher_"):
+                    try:
+                        v.stop(timeout_s=1.0)
+                    except Exception:
+                        pass
         # drain this process's task-event ring synchronously: the
         # controller kills us right after this RPC returns, and the
         # FINISHED events of our last requests (≤0.5 s of batching)

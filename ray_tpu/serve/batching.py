@@ -73,6 +73,7 @@ class _Batcher:
         self._window = 0.0  # adaptive wait; 0 = flush idle arrivals now
         self._num_flushes = 0
         self._thread: Optional[threading.Thread] = None
+        self._stopping = False
         self._self_obj = None
 
     def __getstate__(self):
@@ -94,6 +95,7 @@ class _Batcher:
         entry = {"item": item, "event": threading.Event(),
                  "result": None, "error": None}
         with self._cv:
+            self._stopping = False
             if self._thread is None:
                 # bound instance is fixed per batcher (method batchers
                 # are per-instance), so capturing it at first submit is
@@ -149,10 +151,23 @@ class _Batcher:
             if self._window < 1e-4:
                 self._window = 0.0
 
+    def stop(self, timeout_s: float = 5.0):
+        """Stop the flusher thread once the queue drains (replica
+        teardown). In-flight entries still complete; a later submit
+        restarts the flusher."""
+        with self._cv:
+            t, self._thread = self._thread, None
+            self._stopping = True
+            self._cv.notify_all()
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout_s)
+
     def _flush_loop(self):
         while True:
             with self._cv:
                 while not self._queue:
+                    if self._stopping:
+                        return
                     self._cv.wait()
                 deadline = monotonic() + self._current_window()
                 while len(self._queue) < self.max_batch_size:

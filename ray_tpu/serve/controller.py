@@ -312,7 +312,11 @@ class ServeController:
                         try:
                             h.prepare_drain.remote()
                         except Exception:
-                            pass
+                            logger.debug(
+                                "serve: prepare_drain to resumed-"
+                                "draining replica %s failed (dead? "
+                                "health check removes it)",
+                                rep["name"], exc_info=True)
                     else:
                         # it was serving a moment ago; health checks
                         # will demote it if that changed
@@ -399,14 +403,18 @@ class ServeController:
                                    timeout=10.0)
                 version = meta.get("version")
             except Exception:
-                pass
+                logger.debug("serve: no metadata from readopted "
+                             "replica %r; assuming current version",
+                             name, exc_info=True)
             if info is None:
                 logger.warning("serve: killing orphan replica %r "
                                "(deployment gone)", name)
                 try:
                     ray_tpu.kill(h)
                 except Exception:
-                    pass
+                    logger.debug("serve: kill of orphan replica %r "
+                                 "failed (already gone?)", name,
+                                 exc_info=True)
                 continue
             info.replicas[h] = version or info.version
             info.replica_names[h._id_hex] = name
@@ -845,7 +853,10 @@ class ServeController:
                 try:
                     h.prepare_drain.remote()
                 except Exception:
-                    pass
+                    logger.debug("serve: prepare_drain to draining "
+                                 "replica %s failed (dead? drain "
+                                 "completes on the deadline)", name,
+                                 exc_info=True)
             idle = False
             if now < st["deadline"]:
                 try:
@@ -957,7 +968,11 @@ class ServeController:
                             rexc.ActorUnavailableError):
                         dead = True
                     except Exception:
-                        pass  # user check_health raised / probe error
+                        # user check_health raised / probe error:
+                        # neither ok nor dead — counts as a miss below
+                        logger.debug("serve: health probe on a %r "
+                                     "replica errored", name,
+                                     exc_info=True)
                 if h not in info.replicas:
                     continue  # removed by a concurrent path meanwhile
                 if ok:
@@ -1009,9 +1024,9 @@ class ServeController:
                     load = ray_tpu.get(h.get_load.remote(), timeout=5.0)
                     per_replica[h._id_hex] = load
                     total_queue += load.get("queue_len", 0)
-                except Exception:
-                    # dead/slow replica: the health check owns removal;
-                    # routers just won't get a fresh report for it
+                # dead/slow replica: the health check owns removal;
+                # routers just won't get a fresh report for it
+                except Exception:  # rtpulint: ignore[RTPU007]
                     pass
             if per_replica:
                 load_table[name] = per_replica

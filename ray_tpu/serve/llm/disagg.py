@@ -191,5 +191,7 @@ class KVShipper:
                     try:
                         plasma.ring_free(oid)
                     except Exception:
-                        pass
+                        logger.debug("llm.kv_ship: ring_free of %s "
+                                     "failed (ring torn down first?)",
+                                     oid, exc_info=True)
             self._created.clear()

@@ -131,6 +131,33 @@ def test_worker_kill_schedule_recovers_and_replays(tmp_path):
     assert run1 == run2  # deterministic replay
 
 
+def test_rpc_request_kill_recovers(tmp_path):
+    """`rpc.request` site: a worker SIGKILLs itself before its N-th
+    *served request* handler runs (any method — the site sits in both
+    wire implementations' serve paths); the owner's retry machinery
+    still recovers every result."""
+    log = tmp_path / "chaos_rpc.jsonl"
+    _set_chaos({"seed": 5, "schedule": [
+        {"site": "rpc.request", "op": "kill", "at": 4,
+         "proc": "worker"}]}, log)
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True,
+                 object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_retries=3)
+        def f(x):
+            return x + 10
+
+        out = [ray_tpu.get(f.remote(i), timeout=90) for i in range(4)]
+        assert out == [10, 11, 12, 13]
+    finally:
+        ray_tpu.shutdown()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not chaos.read_log(str(log)):
+        time.sleep(0.2)
+    fired = [(r["site"], r["op"]) for r in chaos.read_log(str(log))]
+    assert ("rpc.request", "kill") in fired, fired
+
+
 # ----------------------------------------------- schedule 2: raylet kill
 
 
