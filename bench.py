@@ -794,6 +794,127 @@ def _dag_bench_main():
     print(json.dumps({"metric": "compiled_dag", **out}), flush=True)
 
 
+def _net_bench_main():
+    """Cross-node transport bench (_BENCH_NET=1): two raylets on one
+    machine restricted to TCP (distinct ``RTPU_NODE_IP`` aliases +
+    ``RTPU_NET_FORCE_TCP``, the same harness as tests/test_netx.py).
+    Measures (a) bulk object pull throughput through the netx ``px_*``
+    plane vs the asyncio chunk-RPC pull baseline — gated against the
+    63 MiB/s SCALE.md round-5 aggregate — (b) direct-lane actor-call
+    RTT across "hosts", (c) compiled-DAG cross-host execute latency.
+    Env: NET_BENCH_SMOKE=1 shrinks the run (CI smoke); NET_BENCH_MB
+    overrides the object size. One JSON line; recorded in PERF.md."""
+    import statistics
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import netx
+    from ray_tpu._private.cluster_utils import Cluster
+    from ray_tpu._private.netx import endpoints
+    from ray_tpu.dag import InputNode
+
+    smoke = bool(os.environ.get("NET_BENCH_SMOKE"))
+    mb = int(os.environ.get("NET_BENCH_MB", "32" if smoke else "256"))
+    iters = 30 if smoke else 200
+    store = max(512, 3 * mb) * 1024 * 1024
+
+    def two_host_cluster(netx_on):
+        os.environ["RTPU_NODE_IP"] = "127.0.0.1"
+        os.environ["RTPU_NET_FORCE_TCP"] = "1"
+        os.environ["RTPU_NETX"] = "1" if netx_on else "0"
+        endpoints._reset_for_tests()
+        netx.reset_client_for_tests()
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2,
+                                          "resources": {"hosta": 4},
+                                          "object_store_memory": store})
+        cluster.add_node(num_cpus=2, resources={"hostb": 4},
+                         object_store_memory=store,
+                         env_overrides={
+                             "RTPU_NODE_IP": "127.0.0.2",
+                             "RTPU_NET_FORCE_TCP": "1",
+                             "RTPU_NETX": "1" if netx_on else "0"})
+        cluster.connect()
+        cluster.wait_for_nodes()
+        return cluster
+
+    def pull_mib_s():
+        # object sealed on "host" B first (the probe task runs next to
+        # it, zero-copy), THEN the driver-side get times the pure
+        # cross-host transfer + local map
+        @ray_tpu.remote(resources={"hostb": 1})
+        def make(n):
+            return np.ones(n, dtype=np.uint8)
+
+        @ray_tpu.remote(resources={"hostb": 1})
+        def probe(x):
+            return int(x[0])
+
+        n = mb * 1024 * 1024
+        ref = make.remote(n)
+        assert ray_tpu.get(probe.remote(ref), timeout=600) == 1
+        t0 = time.perf_counter()
+        arr = ray_tpu.get(ref, timeout=600)
+        dt = time.perf_counter() - t0
+        assert arr.shape == (n,)
+        return mb / dt
+
+    out = {"object_mb": mb}
+    cluster = two_host_cluster(netx_on=False)
+    try:
+        out["asyncio_pull_mib_s"] = round(pull_mib_s(), 1)
+    finally:
+        cluster.shutdown()
+
+    cluster = two_host_cluster(netx_on=True)
+    try:
+        out["netx_pull_mib_s"] = round(pull_mib_s(), 1)
+
+        @ray_tpu.remote(resources={"hostb": 1})
+        class Echo:
+            def e(self, x):
+                return x
+
+        a = Echo.remote()
+        ray_tpu.get(a.e.remote(0), timeout=120)  # lane warm
+        xs = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            ray_tpu.get(a.e.remote(i), timeout=60)
+            xs.append(time.perf_counter() - t0)
+        out["actor_call_rtt_us"] = round(1e6 * statistics.median(xs), 1)
+
+        with InputNode() as inp:
+            s1 = Echo.options(resources={"hosta": 1}).bind()
+            s2 = Echo.options(resources={"hostb": 1}).bind()
+            pipe = s2.e.bind(s1.e.bind(inp))
+        cpipe = pipe.compile()
+        try:
+            assert cpipe._compiled, "cross-host pipeline failed to compile"
+            cpipe.execute(0)  # channel warmup
+            xs = []
+            for i in range(iters):
+                t0 = time.perf_counter()
+                cpipe.execute(i)
+                xs.append(time.perf_counter() - t0)
+            out["dag_cross_host_exec_us"] = round(
+                1e6 * statistics.median(xs), 1)
+        finally:
+            cpipe.teardown()
+    finally:
+        cluster.shutdown()
+        for k in ("RTPU_NODE_IP", "RTPU_NET_FORCE_TCP", "RTPU_NETX"):
+            os.environ.pop(k, None)
+
+    out["pull_speedup_vs_asyncio"] = round(
+        out["netx_pull_mib_s"] / max(out["asyncio_pull_mib_s"], 0.1), 2)
+    # SCALE.md round-5 broadcast baseline: 63 MiB/s aggregate on the
+    # asyncio chunk-RPC path — the netx plane must beat it outright
+    out["gate_pull_63mibs"] = out["netx_pull_mib_s"] >= 63.0
+    print(json.dumps({"metric": "net", **out}), flush=True)
+
+
 def _state_bench_main():
     """State-engine microbench (_BENCH_STATE=1): with 10k+ drained
     tasks in the GCS task table, measure (a) list_tasks first-page p50
@@ -1901,6 +2022,12 @@ def main():
     elif os.environ.get("_BENCH_DAG"):
         try:
             _dag_bench_main()
+        except Exception as e:  # noqa: BLE001 — supervisor parses output
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+    elif os.environ.get("_BENCH_NET"):
+        try:
+            _net_bench_main()
         except Exception as e:  # noqa: BLE001 — supervisor parses output
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)

@@ -134,6 +134,22 @@ SITES: Dict[str, Dict[str, Any]] = {
                   "at its N-th execution (`method` filter = the stage "
                   "id as a string)"),
     },
+    "net.partition": {
+        "ops": ["partition"],
+        "where": ("every cross-node frame send — the netx "
+                  "client/server lanes, the direct-execution lane and "
+                  "the asyncio "
+                  "`Connection` writer all consult the site before "
+                  "writing: while a spec matches, frames from this "
+                  "node toward the target host are dropped and the "
+                  "connection severed (ONE direction of the pair; the "
+                  "reverse stays up). `method` filter = "
+                  "`<src_ip>><dst_ip>` so a schedule names the "
+                  "direction; combine with `until_s` for a partition "
+                  "that heals after a window, exercising "
+                  "reconnect/backoff + fallback with no lost or "
+                  "duplicated invocation"),
+    },
     "llm.kv_ship": {
         "ops": ["drop", "delay", "reset", "corrupt"],
         "where": ("disaggregated LLM serving's prefill→decode KV "
@@ -186,6 +202,12 @@ class FaultSpec:
             return False
         after = self.args.get("after_s")
         if after is not None and elapsed_s < float(after):
+            return False
+        # a sustained fault with `until_s` heals itself: past the
+        # window the spec stops firing even with max_fires=0 — how a
+        # partition "ends" without any process coordinating the repair
+        until = self.args.get("until_s")
+        if until is not None and elapsed_s >= float(until):
             return False
         if self.n == self.at:
             return True

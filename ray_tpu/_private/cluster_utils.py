@@ -61,7 +61,8 @@ class Cluster:
             self.session_dir, self.gcs_address,
             resources=self._res(args),
             labels=args.get("labels"),
-            object_store_memory=args.get("object_store_memory"))
+            object_store_memory=args.get("object_store_memory"),
+            env_overrides=args.get("env_overrides"))
         self.worker_nodes.append(info)
         return info
 
@@ -78,7 +79,8 @@ class Cluster:
             proc = node_mod.start_raylet(
                 self.session_dir, self.gcs_address, node_id,
                 self._res(args), args.get("labels") or {}, is_head=False,
-                object_store_memory=args.get("object_store_memory"))
+                object_store_memory=args.get("object_store_memory"),
+                env_overrides=args.get("env_overrides"))
             procs.append((node_id, proc))
         infos = []
         for node_id, proc in procs:

@@ -41,6 +41,8 @@ def main():
         # pump is disabled/unbuildable); the raylet forwards it in
         # lease_worker replies so owners can skip the asyncio path
         "direct_address": w.direct_address,
+        # 1.8: the lane's host:port twin (netx) for off-box owners
+        "direct_tcp_address": w.direct_tcp_address,
     })
     from ray_tpu.common.config import SystemConfig, set_global_config
     w.config = SystemConfig.from_json(reply["config"])

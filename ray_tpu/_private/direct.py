@@ -63,11 +63,17 @@ def _pack(body) -> bytes:
 
 
 def _chaos_send(pump: rpccore.Pump, cid: int, method: str,
-                data: bytes) -> bool:
-    """Send one frame through the outbound chaos site (same semantics as
-    protocol.Connection._send: drop/delay/dup/reset).  Returns False
-    when the connection is gone (incl. a chaos reset)."""
+                data: bytes, peer_host: str = "") -> bool:
+    """Send one frame through the outbound chaos sites (same semantics
+    as protocol.Connection._send: net.partition when the peer is
+    off-box, then drop/delay/dup/reset).  Returns False when the
+    connection is gone (incl. a chaos reset/partition)."""
     eng = chaos._ENGINE
+    if eng is not None and peer_host:
+        from ray_tpu._private import netx
+        if netx.partitioned(peer_host):
+            pump.close_conn(cid)  # an unplugged cable, not a FIN
+            return False
     if eng is not None:
         act = eng.hit("protocol.send", method)
         if act is not None:
@@ -97,11 +103,23 @@ class DirectServer:
     worker's asyncio handler table (rare — owners only dial this socket
     for the leased fast path)."""
 
-    def __init__(self, worker, path: str):
+    def __init__(self, worker, path: str,
+                 tcp_host: Optional[str] = None):
         self.worker = worker
         self.pump = rpccore.Pump()
         self.pump.listen(path)
         self.address = "unix:" + path
+        # 1.8: the lane's host:port twin — same pump, same frames, so
+        # an off-box owner pushes leased tasks and actor calls with
+        # identical semantics (advertised via worker_register)
+        self.tcp_address = ""
+        if tcp_host:
+            try:
+                port = self.pump.listen_tcp(tcp_host, 0)
+                self.tcp_address = f"{tcp_host}:{port}"
+            except OSError:
+                logger.warning("direct lane: TCP listener on %s failed; "
+                               "lane stays unix-only", tcp_host)
         self.executed = 0  # direct tasks run (tests/bench introspection)
         self._stats_delta = 0
         self._stats_last = time.monotonic()
@@ -268,14 +286,16 @@ class DirectServer:
 
 
 class _DLease:
-    __slots__ = ("key", "lease_id", "cid", "addr", "inflight", "last_used",
-                 "acquiring", "revoked", "released")
+    __slots__ = ("key", "lease_id", "cid", "addr", "peer_host",
+                 "inflight", "last_used", "acquiring", "revoked",
+                 "released")
 
     def __init__(self, key):
         self.key = key
         self.lease_id: Optional[str] = None
         self.cid: Optional[int] = None
         self.addr: Optional[str] = None
+        self.peer_host = ""  # '' = on-box (unix) lane
         self.inflight = 0
         self.last_used = 0.0
         self.acquiring = True
@@ -430,7 +450,8 @@ class DirectClient:
         state.direct = True
         self.submitted += 1
         data = _pack([_REQUEST, seq, "leased_task", {"spec": spec}])
-        if not _chaos_send(self.pump, cid, "leased_task", data):
+        if not _chaos_send(self.pump, cid, "leased_task", data,
+                           L.peer_host):
             self._fail_pending(cid, seq, spec, state)
 
     def _fail_pending(self, cid, seq, spec, state):
@@ -456,12 +477,19 @@ class DirectClient:
         except Exception as e:  # noqa: BLE001
             r = {"error": "LEASE_RPC_FAILED", "message": str(e)}
         now = time.monotonic()
-        direct_addr = (r.get("direct_address") or "") \
-            if not r.get("error") else ""
+        direct_addr = ""
+        peer_host = ""
+        if not r.get("error"):
+            # 1.8: the lease reply advertises both lane endpoints; dial
+            # unix when the worker is on this box, TCP otherwise
+            from ray_tpu._private import netx
+            direct_addr = netx.pick(r.get("direct_address"),
+                                    r.get("direct_tcp_address"))
+            peer_host = netx.host_of(direct_addr)
         cid = None
-        if direct_addr.startswith("unix:"):
+        if direct_addr:
             try:
-                cid = self.pump.dial(direct_addr[5:])
+                cid = self.pump.dial(direct_addr)
             except Exception:
                 cid = None
         if cid is None:
@@ -492,12 +520,14 @@ class DirectClient:
         # never a pending entry; an incompatible-major worker cannot
         # exist inside one session, the hello is for wire parity)
         _chaos_send(self.pump, cid, "__hello__",
-                    _pack([_REQUEST, 0, "__hello__", schema.hello_payload()]))
+                    _pack([_REQUEST, 0, "__hello__", schema.hello_payload()]),
+                    peer_host)
         actions = []
         with self.lock:
             L.acquiring = False
             L.lease_id = r["lease_id"]
             L.addr = r["worker_address"]
+            L.peer_host = peer_host
             L.cid = cid
             L.last_used = now
             self.by_cid[cid] = L
@@ -718,10 +748,12 @@ class DirectClient:
                         break
                 if unparked:
                     break
+            target_host = ""
             if not unparked:
                 for (cid, _seq), (spec, _st, _L) in self.pending.items():
                     if spec["task_id"] == task_id:
                         target_cid = cid
+                        target_host = _L.peer_host
                         break
         if unparked:
             # outside the lock: resolving fires result-event callbacks
@@ -731,7 +763,7 @@ class DirectClient:
         if target_cid is not None:
             _chaos_send(self.pump, target_cid, "cancel_task",
                         _pack([_NOTIFY, None, "cancel_task",
-                               {"task_id": task_id}]))
+                               {"task_id": task_id}]), target_host)
             return True
         return False
 

@@ -333,6 +333,8 @@ class NodeInfo:
                  conn: protocol.Connection):
         self.node_id = node_id
         self.raylet_address: str = payload["raylet_address"]
+        # 1.8: netx transfer endpoint ('' = node serves asyncio-only)
+        self.netx_address: str = payload.get("netx_address", "")
         self.object_store_path: str = payload["object_store_path"]
         self.hostname: str = payload.get("hostname", "")
         self.total_resources: Dict[str, float] = dict(payload["resources"])
@@ -902,6 +904,7 @@ class GcsServer:
             "draining": n.draining,
             "drain_deadline_unix": n.drain_deadline_unix,
             "raylet_address": n.raylet_address,
+            "netx_address": n.netx_address,
             "object_store_path": n.object_store_path,
             "resources": n.total_resources,
             "available": n.available_resources,
@@ -1233,6 +1236,12 @@ class GcsServer:
                 return
             info["node_id"] = node_id
             info["worker_address"] = reply["worker_address"]
+            # 1.8: the worker's direct-lane endpoints ride the actor
+            # record (get_actor / wait_actor_alive) so any caller in
+            # the fleet can push actor_call down the native lane
+            info["direct_address"] = reply.get("direct_address", "")
+            info["direct_tcp_address"] = reply.get(
+                "direct_tcp_address", "")
             info["state"] = ALIVE
             self._persist_actor(aid)
             await self._publish("actor_events",
@@ -1748,7 +1757,9 @@ class GcsServer:
             node = self.nodes.get(nid)
             if node is not None and node.alive:
                 out.append({"node_id": nid,
-                            "raylet_address": node.raylet_address})
+                            "raylet_address": node.raylet_address,
+                            # 1.8: pullers prefer the netx plane
+                            "netx_address": node.netx_address})
         return {"locations": out, "owner": self.object_owners.get(oid)}
 
     async def ping(self, payload, conn):

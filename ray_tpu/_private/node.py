@@ -154,8 +154,15 @@ def start_gcs(session_dir: str, config: SystemConfig,
 def start_raylet(session_dir: str, gcs_address: str, node_id: str,
                  resources: Dict[str, float], labels: Dict[str, str],
                  is_head: bool,
-                 object_store_memory: Optional[int] = None) -> subprocess.Popen:
+                 object_store_memory: Optional[int] = None,
+                 env_overrides: Optional[Dict[str, str]] = None
+                 ) -> subprocess.Popen:
     env = dict(os.environ)
+    if env_overrides:
+        # per-node env (simulated multi-"host" clusters: a distinct
+        # RTPU_NODE_IP per raylet + RTPU_NET_FORCE_TCP makes two local
+        # raylets talk to each other exclusively over TCP)
+        env.update(env_overrides)
     env["RTPU_SESSION_DIR"] = session_dir
     env["RTPU_GCS_ADDRESS"] = gcs_address
     env["RTPU_NODE_ID"] = node_id
@@ -216,11 +223,14 @@ def preempt_raylet(proc: subprocess.Popen) -> bool:
 def add_node(session_dir: str, gcs_address: str,
              resources: Optional[Dict[str, float]] = None,
              labels: Optional[Dict[str, str]] = None,
-             object_store_memory: Optional[int] = None) -> Dict[str, Any]:
+             object_store_memory: Optional[int] = None,
+             env_overrides: Optional[Dict[str, str]] = None
+             ) -> Dict[str, Any]:
     node_id = NodeID.from_random().hex()
     proc = start_raylet(session_dir, gcs_address, node_id, resources or {},
                         labels or {}, is_head=False,
-                        object_store_memory=object_store_memory)
+                        object_store_memory=object_store_memory,
+                        env_overrides=env_overrides)
     info = json.loads(_wait_file(
         os.path.join(session_dir, f"raylet_{node_id[:8]}.json")))
     info["proc"] = proc

@@ -106,6 +106,9 @@ class Connection:
         self._task = asyncio.get_running_loop().create_task(self._read_loop())
         # opaque per-connection state the server attaches (e.g. worker id)
         self.meta: Dict[str, Any] = {}
+        # remote IP for TCP links ('' = unix/unknown): keys the
+        # net.partition chaos site in _send — see netx.endpoints
+        self.peer_host: str = ""
 
     async def _read_loop(self):
         try:
@@ -175,6 +178,14 @@ class Connection:
     async def _send(self, body):
         dup = False
         eng = chaos._ENGINE
+        if eng is not None and self.peer_host:
+            # one-direction partition: every frame toward the severed
+            # host is lost and the link dies (an unplugged cable, not a
+            # polite FIN) — lazy import, netx.client imports this module
+            from ray_tpu._private.netx import endpoints as _nx
+            if _nx.partitioned(self.peer_host):
+                self.close()
+                raise ConnectionError("chaos: network partition")
         if eng is not None:
             # chaos injection point (outbound): body[2] is the method
             act = eng.hit("protocol.send", body[2])
@@ -261,6 +272,9 @@ class Server:
     async def _on_connect(self, reader, writer):
         conn = Connection(reader, writer, handler=self._handle,
                           on_close=self._on_close)
+        peer = writer.get_extra_info("peername")
+        if isinstance(peer, tuple) and peer:
+            conn.peer_host = str(peer[0])
         self.connections.add(conn)
         if "_on_connect" in self.handlers:
             await self.handlers["_on_connect"](conn)
@@ -374,15 +388,19 @@ async def connect(address: str,
                   handler: Optional[Callable] = None,
                   on_close: Optional[Callable] = None) -> Connection:
     """address: 'unix:/path' or 'host:port'."""
+    peer_host = ""
     if address.startswith("unix:"):
         reader, writer = await asyncio.open_unix_connection(address[5:])
     else:
         host, port = address.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
+        peer_host = host
     if handler is None:
         async def handler(method, payload, conn):  # noqa: ARG001
             raise RpcError(f"unexpected request {method}")
-    return Connection(reader, writer, handler=handler, on_close=on_close)
+    conn = Connection(reader, writer, handler=handler, on_close=on_close)
+    conn.peer_host = peer_host
+    return conn
 
 
 class ReconnectingConnection:

@@ -45,7 +45,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import chaos, protocol
+from ray_tpu._private import chaos, netx, protocol
 from ray_tpu._private import task_events as tev
 from ray_tpu._private.object_store import PlasmaxStore
 from ray_tpu._private.sched import PendingTask, bundle_key_of, make_ledger
@@ -186,6 +186,7 @@ class WorkerHandle:
         self.conn: Optional[protocol.Connection] = None
         self.address: str = ""
         self.direct_address: str = ""  # native direct-call lane (1.7)
+        self.direct_tcp_address: str = ""  # off-box direct lane (1.8)
         self.busy_task: Optional[str] = None
         self.leased_by: Optional[str] = None
         self.is_actor = False
@@ -311,7 +312,10 @@ class Raylet:
         self._dispatch_status_buf: Dict[int, Any] = {}
         self._dispatch_status_flush_scheduled = False
         # outbound pull streams being served: (oid, conn-id) -> last ts
-        self._serving_pulls: Dict[Tuple[str, int], float] = {}
+        self._serving_pulls: Dict[Tuple[str, Any], float] = {}
+        # netx transfer server (cross-node object plane) — started in
+        # start() when the native pump is available
+        self._netx_server = None
         # worker leases: owner-held workers for direct task pushes
         # (reference: normal_task_submitter.cc lease-based dispatch)
         self._leases: Dict[str, Any] = {}
@@ -387,10 +391,27 @@ class Raylet:
                                  f"raylet_{self.node_id[:12]}.sock")
         await self.server.start_unix(sock_path)
         tcp_server = protocol.Server(self._handlers())
-        tcp_port = await tcp_server.start_tcp("127.0.0.1", 0)
+        # bind + advertise the node's real address (RTPU_NODE_IP, else
+        # the resolved hostname) so off-box peers can actually dial us;
+        # loopback remains the fallback when the IP won't bind (e.g. a
+        # laptop whose hostname resolves to a stale DHCP lease)
+        host = netx.node_ip()
+        try:
+            tcp_port = await tcp_server.start_tcp(host, 0)
+        except OSError:
+            host = "127.0.0.1"
+            tcp_port = await tcp_server.start_tcp(host, 0)
         self._tcp_server = tcp_server
-        self.address = f"127.0.0.1:{tcp_port}"
+        self.address = f"{host}:{tcp_port}"
         self.unix_address = f"unix:{sock_path}"
+        if netx.enabled():
+            try:
+                self._netx_server = netx.NetxServer(
+                    self, host, asyncio.get_running_loop())
+            except Exception:
+                logger.warning("netx transfer server unavailable; "
+                               "object pulls stay on asyncio",
+                               exc_info=True)
 
         self.gcs = protocol.ReconnectingConnection(
             self.gcs_address, handler=self._gcs_request,
@@ -421,6 +442,11 @@ class Raylet:
         return {
             "node_id": self.node_id,
             "raylet_address": self.address,
+            # the netx transfer endpoint ('' when the native plane is
+            # off) — peers chunk-pipeline object pulls through it
+            # instead of the asyncio pull_object path
+            "netx_address": self._netx_server.address
+            if self._netx_server is not None else "",
             "object_store_path": self.store_path,
             "resources": self.total_resources,
             "labels": self.labels,
@@ -600,6 +626,8 @@ class Raylet:
         handle.conn = conn
         handle.address = payload["address"]
         handle.direct_address = payload.get("direct_address") or ""
+        handle.direct_tcp_address = payload.get(
+            "direct_tcp_address") or ""
         conn.meta["worker_id"] = wid
         if not handle.ready.done():
             handle.ready.set_result(True)
@@ -1175,7 +1203,9 @@ class Raylet:
                 # 1.7 (optional — pre-1.7 owners ignore it): lets the
                 # owner push leased tasks down the worker's native
                 # direct-execution lane instead of the asyncio server
-                "direct_address": handle.direct_address}
+                "direct_address": handle.direct_address,
+                # 1.8: the lane's host:port twin for off-box owners
+                "direct_tcp_address": handle.direct_tcp_address}
 
     async def handle_release_lease(self, payload, conn):
         self._release_lease(payload.get("lease_id", ""))
@@ -1524,7 +1554,12 @@ class Raylet:
             await self._handle_worker_death(handle.worker_id, str(e))
             return {"error": f"actor init failed: {e}", "retryable": False}
         return {"worker_address": handle.address,
-                "worker_id": handle.worker_id}
+                "worker_id": handle.worker_id,
+                # 1.8: direct-lane endpoints ride the actor record so
+                # callers anywhere in the fleet can skip the asyncio
+                # server for actor_call
+                "direct_address": handle.direct_address,
+                "direct_tcp_address": handle.direct_tcp_address}
 
     async def handle_kill_actor_worker(self, payload, conn):
         aid = payload["actor_id"]
@@ -1771,6 +1806,19 @@ class Raylet:
             saw_busy = False
             for loc in locs:
                 try:
+                    netx_addr = loc.get("netx_address") or ""
+                    if netx_addr:
+                        res = await self._netx_fetch(netx_addr, oid)
+                        if res == "done":
+                            return
+                        if res == "busy":
+                            saw_busy = True
+                            continue
+                        if res == "notfound":
+                            continue
+                        # res is None: the netx plane is unavailable for
+                        # this peer (gated off, dial failed, transfer
+                        # severed) — fall through to the asyncio path
                     remote = await protocol.connect(loc["raylet_address"])
                     try:
                         first = await remote.call("pull_object", {
@@ -1871,6 +1919,78 @@ class Raylet:
                 break
         raise RuntimeError(f"could not fetch {oid}: no live copies "
                            f"({last_err})")
+
+    async def _netx_fetch(self, address: str, oid: ObjectID
+                          ) -> Optional[str]:
+        """Pull one object through the netx plane: header via px_get,
+        then px_chunk frames streamed by the holder's serve thread
+        straight into our plasma create buffer on the netx IO thread —
+        this loop only does admission/create/seal bookkeeping, so a GiB
+        transfer costs it microseconds, not seconds of chunk RPCs.
+
+        Returns "done"/"busy"/"notfound"; None means the transport is
+        unavailable for this peer and the caller should fall back to
+        the asyncio pull path. A ValueError from create (live inbound
+        push holds the slot) propagates to the fetch loop's JOIN
+        handler, and data errors (crc) propagate as replica failures —
+        identical discipline to the asyncio path."""
+        client = netx.get_client()
+        if client is None:
+            return None
+        loop_ = asyncio.get_running_loop()
+        hex_id = oid.hex()
+        try:
+            hdr = await loop_.run_in_executor(
+                None, client.get_header, address, hex_id, 15.0)
+        except protocol.RpcError:
+            raise  # the peer answered and refused: failed replica
+        except Exception:
+            return None  # dial failure/backoff/timeout: no transport
+        if hdr.get("busy"):
+            return "busy"
+        if not hdr.get("found"):
+            return "notfound"
+        total = int(hdr["total_size"])
+        if self.store.contains(oid):
+            return "done"
+        admitted = await self._admit_pull(total)
+        try:
+            if self.store.contains(oid):
+                return "done"
+            try:
+                try:
+                    buf = self.store.create(oid, total)
+                except ValueError:
+                    # slot held by an interrupted inbound push: reap
+                    # and take over (a LIVE push re-raises → JOINed by
+                    # the fetch loop)
+                    if not self._abort_stale_push(hex_id, max_age=10.0):
+                        raise
+                    buf = self.store.create(oid, total)
+            except ObjectStoreFullError:
+                await self._spill_until(total)
+                buf = self.store.create(oid, total, allow_fallback=True)
+            try:
+                await loop_.run_in_executor(
+                    None, client.pull_into, address, hex_id, buf, total)
+            except BaseException:
+                # never leak an unsealed create
+                buf.release()
+                self.store.abort(oid)
+                raise
+            buf.release()
+            self.store.seal(oid)
+        except netx.client.PullBusy:
+            return "busy"
+        except netx.client.PullNotFound:
+            return "notfound"
+        except (ConnectionError, TimeoutError):
+            return None  # transfer severed past resume: asyncio fallback
+        finally:
+            await self._release_pull(admitted)
+        await self.gcs.call("add_object_location", {
+            "object_id": hex_id, "node_id": self.node_id})
+        return "done"
 
     @staticmethod
     def _verify_chunk(reply: Dict[str, Any], data, oid: ObjectID):
@@ -2591,6 +2711,11 @@ class Raylet:
 
     def shutdown(self):
         self._shutdown = True
+        if self._netx_server is not None:
+            try:
+                self._netx_server.close()
+            except Exception:
+                pass
         for h in self.workers.values():
             try:
                 h.proc.kill()

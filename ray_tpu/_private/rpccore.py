@@ -21,7 +21,7 @@ from typing import List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-_ABI = 3  # must match rpcx_abi_version() in src/rpccore/rpcx.cc
+_ABI = 4  # must match rpcx_abi_version() in src/rpccore/rpcx.cc
 
 _LIB = None
 _LIB_FAILED = False
@@ -79,6 +79,12 @@ def _lib():
             lib.rpcx_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rpcx_dial.restype = ctypes.c_long
             lib.rpcx_dial.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rpcx_listen_tcp.restype = ctypes.c_int
+            lib.rpcx_listen_tcp.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            lib.rpcx_dial_tcp.restype = ctypes.c_long
+            lib.rpcx_dial_tcp.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
             lib.rpcx_next_batch.restype = ctypes.c_int
             lib.rpcx_next_batch.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_long),
@@ -120,6 +126,17 @@ def _build(src: str, out_path: str):
             os.unlink(tmp)
 
 
+def is_tcp_address(address: str) -> bool:
+    """``host:port`` (optionally ``tcp:``-prefixed) vs a unix socket
+    path / ``unix:`` address. Mirrors protocol.connect's split."""
+    if address.startswith("unix:") or address.startswith("/"):
+        return False
+    if address.startswith("tcp:"):
+        return True
+    host, sep, port = address.rpartition(":")
+    return bool(sep) and bool(host) and port.isdigit()
+
+
 def _reset_for_tests():
     """Drop the cached load state so a test can exercise load failure."""
     global _LIB, _LIB_FAILED
@@ -153,10 +170,34 @@ class Pump:
         if self._lib.rpcx_listen(self._p, path.encode()) != 0:
             raise OSError(f"rpcx: cannot listen on {path}")
 
-    def dial(self, path: str) -> int:
-        cid = self._lib.rpcx_dial(self._p, path.encode())
+    def listen_tcp(self, host: str, port: int = 0) -> int:
+        """Bind a TCP listener on the same reactor; returns the bound
+        port (``port=0`` = ephemeral). Accepted connections speak the
+        identical frame format as the unix path."""
+        bound = self._lib.rpcx_listen_tcp(self._p, host.encode(), port)
+        if bound < 0:
+            raise OSError(f"rpcx: cannot listen on {host}:{port}")
+        return bound
+
+    def dial(self, address: str) -> int:
+        """Dial either a unix socket path or a ``host:port`` TCP
+        endpoint (``unix:`` / ``tcp:`` prefixes accepted)."""
+        if address.startswith("unix:"):
+            address = address[5:]
+        elif address.startswith("tcp:"):
+            address = address[4:]
+        if is_tcp_address(address):
+            host, _, port = address.rpartition(":")
+            return self.dial_tcp(host, int(port))
+        cid = self._lib.rpcx_dial(self._p, address.encode())
         if cid < 0:
-            raise ConnectionError(f"rpcx: cannot dial {path}")
+            raise ConnectionError(f"rpcx: cannot dial {address}")
+        return cid
+
+    def dial_tcp(self, host: str, port: int) -> int:
+        cid = self._lib.rpcx_dial_tcp(self._p, host.encode(), int(port))
+        if cid < 0:
+            raise ConnectionError(f"rpcx: cannot dial {host}:{port}")
         return cid
 
     def next_batch(self, timeout_ms: int = 200

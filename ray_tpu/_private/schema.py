@@ -64,7 +64,18 @@ from typing import Any, Dict, List, Optional, Tuple
 #      worker without the native library simply never sees/sends the
 #      field and everything rides the asyncio path —
 #      docs/WIRE_PROTOCOL.md "Implementations".
-PROTOCOL_VERSION = (1, 7)
+# 1.8: netx cross-node transport plane — endpoints become real
+#      host:port pairs: netx_address on register_node/get_nodes/
+#      get_object_locations (the raylet's transfer server),
+#      direct_tcp_address on worker_register/lease_worker/
+#      create_actor_worker (the direct lane's TCP twin),
+#      channel_tcp_address on dag_channel_open replies, and the px_*
+#      object-transfer methods (px_get/px_pull + px_chunk/px_ack
+#      notifies) served by the netx transfer server. Same-host peers
+#      keep dialing the unix endpoints; a pre-1.8 peer never sees the
+#      new fields and rides the asyncio pull path —
+#      docs/WIRE_PROTOCOL.md "1.8: host:port endpoint advertisement".
+PROTOCOL_VERSION = (1, 8)
 
 # Methods introduced after 1.0 (method -> first schema minor carrying
 # it). Callers gate on the peer's negotiated minor from ``__hello__``
@@ -89,6 +100,8 @@ METHOD_VERSIONS: Dict[str, Tuple[int, int]] = {
     "dag_stage_error": (1, 5), "dag_peer_down": (1, 5),
     "dag_exec": (1, 5), "dag_result": (1, 5),
     "trace_spans": (1, 6), "get_trace": (1, 6), "list_traces": (1, 6),
+    "px_get": (1, 8), "px_pull": (1, 8),
+    "px_chunk": (1, 8), "px_ack": (1, 8),
 }
 
 # Fields added to PRE-EXISTING methods after 1.0 — the compat-critical
@@ -114,6 +127,16 @@ FIELD_VERSIONS: Dict[Tuple[str, str], Tuple[int, int]] = {
     # request + lease_worker reply)
     ("worker_register", "direct_address"): (1, 7),
     ("lease_worker", "direct_address"): (1, 7),
+    # 1.8: netx endpoint advertisement (host:port twins of the unix
+    # endpoints; '' or absent = unix-only peer)
+    ("register_node", "netx_address"): (1, 8),
+    ("get_nodes", "netx_address"): (1, 8),
+    ("get_object_locations", "netx_address"): (1, 8),
+    ("worker_register", "direct_tcp_address"): (1, 8),
+    ("lease_worker", "direct_tcp_address"): (1, 8),
+    ("create_actor_worker", "direct_address"): (1, 8),
+    ("create_actor_worker", "direct_tcp_address"): (1, 8),
+    ("dag_channel_open", "channel_tcp_address"): (1, 8),
 }
 
 _str = str
@@ -135,6 +158,8 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "register_node": {
         "node_id": (_str, True),
         "raylet_address": (_str, True),
+        # 1.8: the node's netx transfer server ("" = asyncio-only)
+        "netx_address": (_str, False),
         "object_store_path": (_str, True),
         "resources": (_dict, True),
         "labels": (_dict, False),
@@ -255,6 +280,21 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     # ---- raylet: object plane (object_manager.proto role)
     "pull_object": {"object_id": (_str, True), "offset": (_int, True),
                     "length": (_int, True)},
+    # ---- netx transfer plane (1.8): chunk-pipelined object pulls on
+    # the raylet's dedicated transfer server (push_manager.cc role,
+    # served by the native pump — _private/netx/server.py)
+    "px_get": {"object_id": (_str, True)},
+    "px_pull": {"object_id": (_str, True), "offset": (_int, True),
+                "stream": (_int, True),
+                # the puller's advertised host: keys the one-direction
+                # net.partition chaos site on server→client chunk sends
+                "from_host": (_str, False)},
+    # notify: one windowed chunk of an object stream (server → puller)
+    "px_chunk": {"stream": (_int, True), "offset": (_int, True),
+                 "data": (_bytes, True), "crc": (_int, False),
+                 "total_size": (_int, False), "last": (_bool, False)},
+    # notify: puller's contiguous high-water ack (-1 = cancel stream)
+    "px_ack": {"stream": (_int, True), "got": (_int, True)},
     "receive_push": {"object_id": (_str, True), "offset": (_int, True),
                      "total_size": (_int, True), "data": (_bytes, True)},
     "fetch_object": {"object_id": (_str, True)},
@@ -312,7 +352,10 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
                         "address": (_str, True),
                         # 1.7: native direct-call lane socket ("" when
                         # the pump is disabled)
-                        "direct_address": (_str, False)},
+                        "direct_address": (_str, False),
+                        # 1.8: the lane's host:port twin for off-box
+                        # owners ("" when netx is off)
+                        "direct_tcp_address": (_str, False)},
     "push_task": {"spec": (_dict, True), "tpu_chips": (_list, False)},
     "task_result": {"task_id": (_str, True), "returns": (_list, True),
                     "app_error": (_bool, False)},

@@ -472,6 +472,7 @@ class Worker:
         # workers run a DirectServer beside the asyncio server, drivers
         # route qualifying leased tasks through a DirectClient
         self.direct_address = ""
+        self.direct_tcp_address = ""
         self._direct_server = None
         self._direct_client = None
 
@@ -505,13 +506,20 @@ class Worker:
                     dsock = os.path.join(
                         session_dir,
                         f"cw_{self.worker_id.hex()[:12]}.direct.sock")
-                    self._direct_server = direct.DirectServer(self, dsock)
+                    from ray_tpu._private import netx
+                    self._direct_server = direct.DirectServer(
+                        self, dsock,
+                        tcp_host=netx.node_ip() if netx.enabled()
+                        else None)
                     self.direct_address = self._direct_server.address
+                    self.direct_tcp_address = \
+                        self._direct_server.tcp_address
                 except Exception:
                     logger.warning("direct lane unavailable; using the "
                                    "asyncio path", exc_info=True)
                     self._direct_server = None
                     self.direct_address = ""
+                    self.direct_tcp_address = ""
         self.gcs_address = gcs_address
         # survives a GCS restart: calls retry after re-dial (GCS fault
         # tolerance; reference: gcs_rpc_client.h reconnection). The
@@ -638,6 +646,7 @@ class Worker:
             except Exception:
                 pass
         self.direct_address = ""
+        self.direct_tcp_address = ""
         # compiled-DAG channels: close the listener + stage sockets and
         # free the plasmax ring slots before the store goes away
         ep = getattr(self, "_dag_endpoint", None)

@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu._private import netx, serialization, worker as worker_mod
 from ray_tpu._private.worker import (ObjectRef, PendingTaskState,
                                      global_worker)
 from ray_tpu.common.ids import ActorID, ObjectID, TaskID
@@ -50,6 +50,9 @@ class ActorHandle:
         self._class_name = class_name
         self._max_task_retries = max_task_retries
         self._worker_address: Optional[str] = None
+        # picked direct-lane endpoint (unix same-host, host:port off-box);
+        # "" = none advertised — calls then ride the asyncio peer path
+        self._direct_addr: str = ""
         self._seq = 0
         self._lock = threading.Lock()
         self._dead_reason: Optional[str] = None
@@ -85,6 +88,8 @@ class ActorHandle:
             raise exc.ActorDiedError(self._id_hex,
                                      info.get("death_cause") or "dead")
         self._worker_address = info["worker_address"]
+        self._direct_addr = netx.pick(info.get("direct_address"),
+                                      info.get("direct_tcp_address"))
         return self._worker_address
 
     def _remote_call(self, method: str, args, kwargs,
@@ -144,8 +149,20 @@ class ActorHandle:
                 addr = self._worker_address
                 if addr is None:
                     addr = await _to_thread(self._resolve_address)
-                conn = await w._peer(addr)
-                ret = await conn.call("actor_call", payload)
+                # retries skip the direct lane: a severed TCP direction
+                # (net.partition) must not pin every retry to the dead
+                # fast path while the worker's own socket still answers
+                direct = self._direct_addr if attempt == 0 else ""
+                nx = netx.get_client() if direct else None
+                if nx is not None:
+                    # direct lane (1.8): frame goes out inside call_async
+                    # itself, so event-loop start order is still the wire
+                    # order; failures surface as ConnectionError and take
+                    # the same restart/retry path as a dropped peer conn
+                    ret = await nx.call_async(direct, "actor_call", payload)
+                else:
+                    conn = await w._peer(addr)
+                    ret = await conn.call("actor_call", payload)
                 _store_actor_result(w, state, ret)
                 w.mark_actor_seq_done(self._id_hex, payload["seq"])
             except exc.ActorDiedError as e:
@@ -153,6 +170,7 @@ class ActorHandle:
                 w.mark_actor_seq_done(self._id_hex, payload["seq"])
             except Exception as e:  # connection error → maybe restart
                 self._worker_address = None
+                self._direct_addr = ""
                 info = None
                 try:
                     info = await w.gcs.call(
@@ -379,6 +397,8 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
                          info.get("class_name", ""))
     if info.get("worker_address"):
         handle._worker_address = info["worker_address"]
+        handle._direct_addr = netx.pick(info.get("direct_address"),
+                                        info.get("direct_tcp_address"))
     return handle
 
 
@@ -391,6 +411,8 @@ def get_actor_by_id(actor_id_hex: str) -> ActorHandle:
                          info.get("class_name", ""))
     if info.get("worker_address"):
         handle._worker_address = info["worker_address"]
+        handle._direct_addr = netx.pick(info.get("direct_address"),
+                                        info.get("direct_tcp_address"))
     return handle
 
 

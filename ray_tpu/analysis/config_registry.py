@@ -115,6 +115,45 @@ STATIC_VARS: Dict[str, Dict[str, str]] = {
         "rpc", "0",
         "Validate every inbound RPC payload against the schema table "
         "(tests enable it so schema drift fails immediately)."),
+    # ------------------------------------------------------------- netx
+    "RTPU_NETX": _e(
+        "netx", "1",
+        "Enable the cross-node transport plane (schema 1.8): TCP "
+        "endpoint advertisement, direct-lane actor/task calls off-box, "
+        "and chunk-pipelined object pulls; 0 keeps everything on the "
+        "unix/asyncio paths."),
+    "RTPU_NODE_IP": _e(
+        "netx", "(resolved hostname)",
+        "IP this node advertises for its TCP endpoints (raylet, direct "
+        "lane, dag channels). Falls back to the resolved non-loopback "
+        "hostname, then 127.0.0.1."),
+    "RTPU_NET_FORCE_TCP": _e(
+        "netx", "0",
+        "Prefer host:port endpoints even for same-host peers (the "
+        "multi-\"host\" test harness uses it to exercise the TCP lanes "
+        "on one machine)."),
+    "RTPU_NET_KEEPALIVE_S": _e(
+        "netx", "10",
+        "Quiet-connection ping interval for the netx pool; a peer "
+        "missing ~3 windows is declared dead and redialed with "
+        "backoff."),
+    "RTPU_NET_IDLE_S": _e(
+        "netx", "60",
+        "Idle cross-node connections are reaped from the netx pool "
+        "after this many seconds."),
+    "RTPU_NET_RECONNECT_S": _e(
+        "netx", "0.2",
+        "Initial redial backoff after a netx connection failure; "
+        "doubles per failure up to a 5 s cap."),
+    "RTPU_NET_POOL_MAX": _e(
+        "netx", "16",
+        "Soft cap on pooled netx connections per process; LRU-idle "
+        "peers are evicted beyond it."),
+    "RTPU_NET_STALL_S": _e(
+        "netx", "10",
+        "A chunked object pull making no progress for this long is "
+        "cancelled and resumed from its contiguous high-water mark "
+        "(fresh connection, remaining attempts)."),
     # ------------------------------------------------------- observability
     "RTPU_CPROFILE_DIR": _e(
         "observability", "(unset = off)",

@@ -183,7 +183,15 @@ class StandardAutoscaler:
             n = by_gcs.get(nid)
             if n is None:
                 continue
-            idle = (n.get("available") == n.get("resources"))
+            res = n.get("resources") or {}
+            avail = n.get("available") or {}
+            # float resources (memory = fraction of host bytes) can
+            # differ in the last ulp between the registration snapshot
+            # and heartbeat arithmetic — exact dict equality would then
+            # never see the node as idle
+            idle = all(
+                abs(avail.get(k, 0.0) - v) <= 1e-6 * max(1.0, abs(v))
+                for k, v in res.items())
             if not idle:
                 self._idle_since.pop(nid, None)
                 continue

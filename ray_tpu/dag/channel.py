@@ -41,7 +41,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu._private import chaos, protocol, serialization, tracing
+from ray_tpu._private import chaos, netx, protocol, serialization, tracing
 from ray_tpu.common.ids import ObjectID
 
 logger = logging.getLogger(__name__)
@@ -73,16 +73,35 @@ class FrameSocket:
         self._lock = threading.Lock()
         self._closed = False
         self.peer = peer
+        self.peer_host = netx.host_of(peer)  # '' for unix/accepted conns
 
     @classmethod
     def dial(cls, address: str) -> "FrameSocket":
-        if not address.startswith("unix:"):
-            raise ChannelClosed(f"dag channels are unix-only: {address}")
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.connect(address[5:])
+        """Dial a channel endpoint: ``unix:<path>`` on-box,
+        ``host:port`` (1.8) across nodes."""
+        if address.startswith("unix:"):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(address[5:])
+            return cls(s, peer=address)
+        host, sep, port = address.rpartition(":")
+        if not sep:
+            raise ChannelClosed(f"bad dag channel address: {address}")
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.connect((host, int(port)))
+        except OSError as e:
+            s.close()
+            raise ChannelClosed(str(e)) from e
         return cls(s, peer=address)
 
     def send(self, method: str, payload: Dict[str, Any]):
+        if self.peer_host and netx.partitioned(self.peer_host):
+            # one-direction sever: the frame is lost AND the socket dies
+            # (an unplugged cable) — the stage reports over the control
+            # plane and the driver falls back to dynamic dispatch
+            self.close()
+            raise ChannelClosed("chaos: network partition")
         act = chaos.hit("dag.channel", method)
         if act is not None:
             op = act["op"]
@@ -142,7 +161,8 @@ class DagListener:
     executor on workers (recv → exec → forward with no handoff)."""
 
     def __init__(self, path: str,
-                 handler: Callable[[str, Dict[str, Any]], None]):
+                 handler: Callable[[str, Dict[str, Any]], None],
+                 tcp_host: Optional[str] = None):
         self.path = path
         self.address = f"unix:{path}"
         self.handler = handler
@@ -155,16 +175,42 @@ class DagListener:
         self._sock.listen(64)
         self._closed = False
         self._conns: List[FrameSocket] = []
+        # 1.8: host:port twin of the endpoint — same frames, same reader
+        # threads, so a stage on another node forwards identically
+        self.tcp_address = ""
+        self._tcp_sock: Optional[socket.socket] = None
+        if tcp_host:
+            try:
+                ts = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                ts.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                ts.bind((tcp_host, 0))
+                ts.listen(64)
+                self._tcp_sock = ts
+                self.tcp_address = f"{tcp_host}:{ts.getsockname()[1]}"
+            except OSError:
+                logger.warning("dag listener: TCP endpoint on %s failed; "
+                               "channels stay unix-only", tcp_host)
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="rtpu-dag-accept", daemon=True)
+            target=self._accept_loop, args=(self._sock,),
+            name="rtpu-dag-accept", daemon=True)
         self._accept_thread.start()
+        if self._tcp_sock is not None:
+            threading.Thread(
+                target=self._accept_loop, args=(self._tcp_sock,),
+                name="rtpu-dag-accept-tcp", daemon=True).start()
 
-    def _accept_loop(self):
+    def _accept_loop(self, lsock: socket.socket):
         while not self._closed:
             try:
-                conn, _ = self._sock.accept()
+                conn, _ = lsock.accept()
             except OSError:
                 return
+            if conn.family == socket.AF_INET:
+                try:
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
             fs = FrameSocket(conn)
             self._conns.append(fs)
             threading.Thread(target=self._reader_loop, args=(fs,),
@@ -189,6 +235,11 @@ class DagListener:
             self._sock.close()
         except OSError:
             pass
+        if self._tcp_sock is not None:
+            try:
+                self._tcp_sock.close()
+            except OSError:
+                pass
         for fs in self._conns:
             fs.close()
         try:
@@ -330,11 +381,13 @@ class StageRuntime:
             nslots=int(ring_cfg.get("slots", 2)),
             slot_bytes=int(ring_cfg.get("slot_bytes", 1 << 20)))
         self.inline_max = worker.config.max_inline_object_size
-        # downstream peers: [{"stage_id", "address", "sink", "index"}] —
-        # dial now, keep forever (sink = the driver's result endpoint)
+        # downstream peers: [{"stage_id", "address", "tcp_address",
+        # "sink", "index"}] — dial now, keep forever (sink = the
+        # driver's result endpoint); unix on-box, host:port off-box
         self.downstream: List[Dict[str, Any]] = []
         for peer in payload["downstream"]:
-            fs = FrameSocket.dial(peer["address"])
+            addr = netx.pick(peer.get("address"), peer.get("tcp_address"))
+            fs = FrameSocket.dial(addr)
             self.downstream.append({"sock": fs, "sink": peer.get("sink"),
                                     "stage_id": int(peer.get("stage_id",
                                                              -1)),
@@ -474,8 +527,11 @@ class DagEndpoint:
         path = os.path.join(
             worker.session_dir or "/tmp",
             f"dagch_{worker.worker_id.hex()[:12]}.sock")
-        self.listener = DagListener(path, self._on_frame)
+        self.listener = DagListener(
+            path, self._on_frame,
+            tcp_host=netx.node_ip() if netx.enabled() else None)
         self.address = self.listener.address
+        self.tcp_address = self.listener.tcp_address
         self.stages: Dict[tuple, StageRuntime] = {}
         # driver side: (dag_id, seq) -> _Invocation
         self.inbox: Dict[tuple, Any] = {}
@@ -507,7 +563,8 @@ class DagEndpoint:
             self.stages[key] = rt
         if old is not None:
             old.close()
-        return {"channel_address": self.address}
+        return {"channel_address": self.address,
+                "channel_tcp_address": self.tcp_address}
 
     def close_stage(self, dag_id: str, stage_id: Optional[int] = None):
         with self._lock:

@@ -179,7 +179,8 @@ def _watchdog() -> _Watchdog:
 
 class _Stage:
     __slots__ = ("node", "stage_id", "upstream", "consumers", "out_index",
-                 "actor", "address", "channel_address", "trigger")
+                 "actor", "address", "channel_address",
+                 "channel_tcp_address", "trigger")
 
     def __init__(self, node: ClassMethodNode, stage_id: int):
         self.node = node
@@ -190,6 +191,7 @@ class _Stage:
         self.actor = None
         self.address: Optional[str] = None
         self.channel_address: Optional[str] = None
+        self.channel_tcp_address: str = ""  # 1.8: host:port twin
         self.trigger: Optional[dagch.FrameSocket] = None
 
 
@@ -341,9 +343,11 @@ class CompiledDAG:
                 for c in s.consumers:
                     downstream.append({
                         "stage_id": c,
-                        "address": self._stages[c].channel_address})
+                        "address": self._stages[c].channel_address,
+                        "tcp_address": self._stages[c].channel_tcp_address})
                 if s.out_index is not None:
                     downstream.append({"address": ep.address, "sink": True,
+                                       "tcp_address": ep.tcp_address,
                                        "index": s.out_index})
                 payload = {
                     "dag_id": self.dag_id,
@@ -369,11 +373,16 @@ class CompiledDAG:
                     raise CompileError(
                         f"channel open refused by {s.address}: {e}")
                 s.channel_address = r["channel_address"]
+                # 1.7-or-older stages omit the field: absent ⇒ unix-only
+                s.channel_tcp_address = r.get("channel_tcp_address") or ""
                 opened.append(s)
-            # pre-dial the trigger sockets to every entry stage
+            # pre-dial the trigger sockets to every entry stage (unix
+            # on-box, the 1.8 host:port endpoint across nodes)
             for s in self._stages:
                 if s.upstream is None:
-                    s.trigger = dagch.FrameSocket.dial(s.channel_address)
+                    from ray_tpu._private import netx
+                    s.trigger = dagch.FrameSocket.dial(netx.pick(
+                        s.channel_address, s.channel_tcp_address))
         except CompileError:
             for s in opened:
                 self._close_stage(w, s)

@@ -29,6 +29,7 @@
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
@@ -36,7 +37,11 @@
 #include <unordered_map>
 #include <vector>
 
+#include <arpa/inet.h>
 #include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -76,7 +81,8 @@ struct Event {
 struct Pump {
   int ep = -1;
   int wake_fd = -1;
-  int listen_fd = -1;
+  int listen_fd = -1;      // AF_UNIX listener (tag UINT64_MAX - 1)
+  int listen_fd_tcp = -1;  // AF_INET listener (tag UINT64_MAX - 2)
   std::mutex mu;  // conns map + event queue + ids
   std::unordered_map<long, Conn*> conns;
   std::deque<Event> q;
@@ -100,8 +106,17 @@ int64_t now_ms() {
   return int64_t(tv.tv_sec) * 1000 + tv.tv_usec / 1000;
 }
 
+// TCP fast-path socket options; silently no-ops on AF_UNIX fds (the
+// setsockopt fails with EOPNOTSUPP and we don't care)
+void set_tcp_opts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+}
+
 Conn* add_conn(Pump* p, int fd) {
   set_nonblock(fd);
+  set_tcp_opts(fd);
   auto* c = new Conn();
   c->fd = fd;
   {
@@ -189,9 +204,9 @@ void drain_readable(Pump* p, Conn* c) {
   }
 }
 
-void accept_ready(Pump* p) {
+void accept_ready(Pump* p, int listen_fd) {
   for (;;) {
-    int fd = ::accept4(p->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+    int fd = ::accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
     if (fd < 0) return;
     add_conn(p, fd);
   }
@@ -210,8 +225,12 @@ void reactor_step(Pump* p, int timeout_ms) {
       }
       continue;
     }
-    if (tag == UINT64_MAX - 1) {  // listener
-      accept_ready(p);
+    if (tag == UINT64_MAX - 1) {  // unix listener
+      accept_ready(p, p->listen_fd);
+      continue;
+    }
+    if (tag == UINT64_MAX - 2) {  // tcp listener
+      accept_ready(p, p->listen_fd_tcp);
       continue;
     }
     Conn* c = nullptr;
@@ -238,7 +257,7 @@ extern "C" {
 
 // bumped on any signature/semantic change; the Python loader refuses a
 // stale .so (a rebuilt checkout can otherwise load yesterday's binary)
-int rpcx_abi_version() { return 3; }
+int rpcx_abi_version() { return 4; }
 
 void* rpcx_create() {
   auto* p = new Pump();
@@ -274,6 +293,77 @@ int rpcx_listen(void* vp, const char* path) {
   ev.data.u64 = UINT64_MAX - 1;
   epoll_ctl(p->ep, EPOLL_CTL_ADD, fd, &ev);
   return 0;
+}
+
+// TCP listener (netx off-box transport). Binds host:port (port 0 =
+// ephemeral) and returns the BOUND port, or -1. Framing on accepted
+// connections is byte-identical to the unix path — same parse_frames,
+// same kMaxFrame — so the schema-1.7 conformance vectors run unchanged.
+int rpcx_listen_tcp(void* vp, const char* host, int port) {
+  auto* p = static_cast<Pump*>(vp);
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  set_nonblock(fd);
+  p->listen_fd_tcp = fd;
+  struct epoll_event ev;
+  std::memset(&ev, 0, sizeof(ev));
+  ev.events = EPOLLIN;
+  ev.data.u64 = UINT64_MAX - 2;
+  epoll_ctl(p->ep, EPOLL_CTL_ADD, fd, &ev);
+  return ntohs(addr.sin_port);
+}
+
+// Dial host:port. Hostname resolution via getaddrinfo (numeric IPs skip
+// the resolver). Blocking connect, same as the unix dial — callers hold
+// no pump lock while dialing.
+long rpcx_dial_tcp(void* vp, const char* host, int port) {
+  auto* p = static_cast<Pump*>(vp);
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  struct addrinfo* res = nullptr;
+  if (::getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr)
+    return -1;
+  int fd = ::socket(res->ai_family, res->ai_socktype | SOCK_CLOEXEC,
+                    res->ai_protocol);
+  if (fd < 0) {
+    ::freeaddrinfo(res);
+    return -1;
+  }
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  ::freeaddrinfo(res);
+  if (rc < 0) {
+    ::close(fd);
+    return -1;
+  }
+  Conn* c = add_conn(p, fd);
+  uint64_t one = 1;
+  ssize_t wrc = ::write(p->wake_fd, &one, 8);
+  (void)wrc;
+  return c->id;
 }
 
 long rpcx_dial(void* vp, const char* path) {
@@ -451,6 +541,7 @@ void rpcx_destroy(void* vp) {
   for (auto& e : p->q) std::free(e.data);
   p->q.clear();
   if (p->listen_fd >= 0) ::close(p->listen_fd);
+  if (p->listen_fd_tcp >= 0) ::close(p->listen_fd_tcp);
   ::close(p->wake_fd);
   ::close(p->ep);
   delete p;

@@ -24,18 +24,31 @@ import pytest
 from ray_tpu._private import protocol, rpccore, schema
 
 
-def _native_roundtrip(frame: bytes) -> None:
-    """Assert the native pump (a) delivers exactly the vector's body
-    when the vector's bytes arrive on the wire and (b) produces exactly
-    the vector's bytes when asked to send that body."""
-    if rpccore._lib() is None:
-        pytest.skip("native rpc library unavailable on this host")
-    pump = rpccore.Pump()
+def _native_listener(pump, transport: str):
+    """Bind the pump on the requested transport; returns a connected
+    raw client socket and the unix path to unlink (or None)."""
+    if transport == "tcp":
+        port = pump.listen_tcp("127.0.0.1", 0)
+        raw = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        raw.connect(("127.0.0.1", port))
+        return raw, None
     path = tempfile.mktemp(suffix=".sock")
     pump.listen(path)
     raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.connect(path)
+    return raw, path
+
+
+def _native_roundtrip(frame: bytes, transport: str = "unix") -> None:
+    """Assert the native pump (a) delivers exactly the vector's body
+    when the vector's bytes arrive on the wire and (b) produces exactly
+    the vector's bytes when asked to send that body — over the unix
+    listener or the 1.8 TCP listener (identical framing either way)."""
+    if rpccore._lib() is None:
+        pytest.skip("native rpc library unavailable on this host")
+    pump = rpccore.Pump()
+    raw, path = _native_listener(pump, transport)
     try:
-        raw.connect(path)
         raw.settimeout(10)
         # wire -> pump: the pump must deframe to exactly the body
         raw.sendall(frame)
@@ -57,8 +70,11 @@ def _native_roundtrip(frame: bytes) -> None:
         raw.close()
         pump.shutdown()
         pump.destroy()
-        if os.path.exists(path):
+        if path is not None and os.path.exists(path):
             os.unlink(path)
+
+
+_IMPLS = ["python", "native", "native-tcp"]
 
 
 def _check_vector(impl: str, body_list, hex_frame: str) -> None:
@@ -66,9 +82,11 @@ def _check_vector(impl: str, body_list, hex_frame: str) -> None:
     assert frame.hex() == hex_frame
     if impl == "native":
         _native_roundtrip(frame)
+    elif impl == "native-tcp":
+        _native_roundtrip(frame, transport="tcp")
 
 
-@pytest.mark.parametrize("impl", ["python", "native"])
+@pytest.mark.parametrize("impl", _IMPLS)
 def test_frame_layout_golden_vectors(impl):
     # NOTIFY task_done
     _check_vector(impl,
@@ -83,7 +101,7 @@ def test_frame_layout_golden_vectors(impl):
                   "0d000000940101a470696e6781a26f6bc3")
 
 
-@pytest.mark.parametrize("impl", ["python", "native"])
+@pytest.mark.parametrize("impl", _IMPLS)
 def test_dag_channel_frame_golden_vectors(impl):
     """Compiled-DAG channel frames (1.5; docs/WIRE_PROTOCOL.md §1.5 +
     docs/COMPILED_DAGS.md). They ride dedicated channel sockets but use
@@ -98,6 +116,8 @@ def test_dag_channel_frame_golden_vectors(impl):
         "6461675f6578656384a164a561622e6731a17400a17301a162c40101")
     if impl == "native":
         _native_roundtrip(frame)
+    elif impl == "native-tcp":
+        _native_roundtrip(frame, transport="tcp")
     frame = pack_dag_frame("dag_result", {"d": "ab.g1", "s": 1, "i": 0,
                                           "ae": False, "b": b"\x02"})
     assert frame.hex() == (
@@ -106,6 +126,8 @@ def test_dag_channel_frame_golden_vectors(impl):
         "a17301a16900a26165c2a162c40102")
     if impl == "native":
         _native_roundtrip(frame)
+    elif impl == "native-tcp":
+        _native_roundtrip(frame, transport="tcp")
     for method in ("dag_channel_open", "dag_channel_close",
                    "dag_register", "dag_unregister", "dag_stage_error",
                    "dag_peer_down", "dag_exec", "dag_result"):
@@ -113,7 +135,7 @@ def test_dag_channel_frame_golden_vectors(impl):
     assert schema.PROTOCOL_VERSION >= (1, 5)
 
 
-@pytest.mark.parametrize("impl", ["python", "native"])
+@pytest.mark.parametrize("impl", _IMPLS)
 def test_leased_task_frame_both_framers(impl):
     """The direct-execution lane's hot frame (1.7): a leased_task
     REQUEST must be byte-identical from either implementation — the
@@ -127,20 +149,20 @@ def test_leased_task_frame_both_framers(impl):
         0, 7, "leased_task", {"spec": {"task_id": "ab", "fn_key": "k"}}]
     if impl == "native":
         _native_roundtrip(frame)
+    elif impl == "native-tcp":
+        _native_roundtrip(frame, transport="tcp")
 
 
-def test_native_framer_rejects_oversized_frames():
+@pytest.mark.parametrize("transport", ["unix", "tcp"])
+def test_native_framer_rejects_oversized_frames(transport):
     """A length prefix above _MAX_FRAME is a protocol error in BOTH
-    implementations: read_frame raises, the native pump drops the
-    connection."""
+    implementations and over BOTH listeners: read_frame raises, the
+    native pump drops the connection."""
     if rpccore._lib() is None:
         pytest.skip("native rpc library unavailable on this host")
     pump = rpccore.Pump()
-    path = tempfile.mktemp(suffix=".sock")
-    pump.listen(path)
-    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw, path = _native_listener(pump, transport)
     try:
-        raw.connect(path)
         raw.sendall(struct.pack("<I", protocol._MAX_FRAME + 1) + b"x")
         evs = []
         for _ in range(100):
@@ -153,7 +175,40 @@ def test_native_framer_rejects_oversized_frames():
         raw.close()
         pump.shutdown()
         pump.destroy()
-        if os.path.exists(path):
+        if path is not None and os.path.exists(path):
+            os.unlink(path)
+
+
+@pytest.mark.parametrize("transport", ["unix", "tcp"])
+def test_native_framer_mid_frame_reset(transport):
+    """A peer dying mid-frame (length prefix + partial body, then a
+    hard close) must surface as exactly one KIND_CLOSED — never a
+    truncated KIND_FRAME."""
+    if rpccore._lib() is None:
+        pytest.skip("native rpc library unavailable on this host")
+    body = msgpack.packb([protocol.REQUEST, 1, "ping", {}],
+                         use_bin_type=True)
+    frame = struct.pack("<I", len(body)) + body
+    pump = rpccore.Pump()
+    raw, path = _native_listener(pump, transport)
+    try:
+        raw.sendall(frame[:len(frame) - 3])  # stop 3 bytes short
+        if transport == "tcp":
+            # RST instead of FIN: SO_LINGER 0 makes close() abortive
+            raw.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                           struct.pack("ii", 1, 0))
+        raw.close()
+        evs = []
+        for _ in range(100):
+            evs = pump.next_batch(timeout_ms=200)
+            if evs:
+                break
+        assert evs and evs[0][1] == rpccore.KIND_CLOSED
+        assert all(kind != rpccore.KIND_FRAME for _, kind, _b in evs)
+    finally:
+        pump.shutdown()
+        pump.destroy()
+        if path is not None and os.path.exists(path):
             os.unlink(path)
 
 
